@@ -1,0 +1,166 @@
+"""Config registry.
+
+The reference uses enum-typed config registries loaded from Java properties
+files (``utils/Config.java:126-204``; parameter enums ``PaxosConfig.PC``,
+``ReconfigurationConfig.RC``) plus a node-topology section with lines like
+``active.AR0=host:port`` / ``reconfigurator.RC0=host:port``
+(``gigapaxos.properties:8-15``).
+
+Here: one dataclass per subsystem with typed defaults, overridable from a
+properties file (same ``key=value`` format, same ``active.*`` /
+``reconfigurator.*`` topology lines so the reference's test fixtures map 1:1)
+and from environment variables named ``GPTPU_<SECTION>_<FIELD>``
+(e.g. ``GPTPU_PAXOS_WINDOW=16``); call :func:`apply_env_overrides` to apply
+them to an existing config, or use :func:`load_properties` which applies them
+last.  All override paths re-run dataclass validation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Tuple
+
+
+@dataclass
+class PaxosTuning:
+    """Data-plane knobs (analog of PaxosConfig.PC, PaxosConfig.java:208)."""
+
+    # Max groups per shard (rows in the dense state arrays).
+    max_groups: int = 1024
+    # Out-of-order window W per group: ring-buffer depth for accepted pvalues
+    # and undelivered decisions (replaces the reference's sparse
+    # accepted/committed maps, PaxosAcceptor.java:108-115).  Power of two.
+    window: int = 8
+    # Max replicas per group (padding width of the member table).
+    max_replicas: int = 3
+    # Max new proposals accepted per group per tick at each entry replica.
+    proposals_per_tick: int = 4
+    # Checkpoint every this many executed slots per group
+    # (PaxosInstanceStateMachine.java:123-130 CHECKPOINT_INTERVAL analog).
+    checkpoint_interval: int = 400
+    # How many ticks of inbox log between forced journal fsyncs.
+    sync_every_ticks: int = 1
+    # Deactivation: spill groups idle for this many ticks to host (pause
+    # analog, PaxosManager.java:2284-2365).
+    deactivation_ticks: int = 10_000
+
+    def __post_init__(self) -> None:
+        if self.window < 2 or (self.window & (self.window - 1)):
+            raise ValueError(
+                f"window must be a power of two >= 2, got {self.window}"
+            )
+
+
+@dataclass
+class FailureDetectionConfig:
+    """FailureDetection.java:63-76 analog (host-level, per node pair)."""
+
+    ping_interval_s: float = 0.1  # max 1 ping / 100ms, FailureDetection.java:65-66
+    timeout_s: float = 3.0
+    coordinator_failover_grace_ticks: int = 2
+
+
+@dataclass
+class NodeConfig:
+    """Cluster topology: node id -> (host, port).
+
+    Mirrors the ``active.*`` / ``reconfigurator.*`` lines of
+    ``gigapaxos.properties`` so reference fixtures translate directly.
+    """
+
+    actives: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    reconfigurators: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+
+    def active_ids(self):
+        return sorted(self.actives)
+
+    def reconfigurator_ids(self):
+        return sorted(self.reconfigurators)
+
+
+@dataclass
+class GigapaxosTpuConfig:
+    paxos: PaxosTuning = field(default_factory=PaxosTuning)
+    fd: FailureDetectionConfig = field(default_factory=FailureDetectionConfig)
+    nodes: NodeConfig = field(default_factory=NodeConfig)
+    # WAL directory; None = in-memory only (tests).
+    log_dir: str | None = None
+    # Use the C++ journal backend when available.
+    native_journal: bool = True
+
+
+def _parse_scalar(txt: str, ty: type):
+    if ty is bool:
+        return txt.strip().lower() in ("1", "true", "yes", "on")
+    if ty is int:
+        return int(txt)
+    if ty is float:
+        return float(txt)
+    return txt
+
+
+def load_properties(path: str) -> GigapaxosTpuConfig:
+    """Load a gigapaxos.properties-style file.
+
+    Recognized keys: ``active.<ID>=host:port``, ``reconfigurator.<ID>=host:port``
+    and flat tuning keys like ``paxos.window=16`` / ``fd.timeout_s=5``.
+    Unknown keys are ignored (the reference likewise ignores params it does
+    not know, utils/Config.java:150-170).
+    """
+    cfg = GigapaxosTpuConfig()
+    with open(path) as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith(("#", "!")):
+                continue
+            if "=" not in line:
+                continue
+            key, val = line.split("=", 1)
+            key, val = key.strip(), val.strip()
+            if key.startswith("active."):
+                host, port = val.rsplit(":", 1)
+                cfg.nodes.actives[key[len("active.") :]] = (host, int(port))
+            elif key.startswith("reconfigurator."):
+                host, port = val.rsplit(":", 1)
+                cfg.nodes.reconfigurators[key[len("reconfigurator.") :]] = (
+                    host,
+                    int(port),
+                )
+            elif "." in key:
+                section, fname = key.split(".", 1)
+                sub = getattr(cfg, section, None)
+                if sub is not None and dataclasses.is_dataclass(sub):
+                    for f_ in dataclasses.fields(sub):
+                        if f_.name == fname:
+                            setattr(
+                                sub,
+                                fname,
+                                _parse_scalar(val, type(getattr(sub, fname))),
+                            )
+            elif hasattr(cfg, key):
+                cur = getattr(cfg, key)
+                setattr(cfg, key, _parse_scalar(val, type(cur) if cur is not None else str))
+    apply_env_overrides(cfg)
+    return cfg
+
+
+def apply_env_overrides(cfg: GigapaxosTpuConfig) -> None:
+    """Apply ``GPTPU_<SECTION>_<FIELD>`` environment overrides and re-validate."""
+    for sub_name in ("paxos", "fd"):
+        sub = getattr(cfg, sub_name)
+        for f_ in dataclasses.fields(sub):
+            env = os.environ.get(f"GPTPU_{sub_name.upper()}_{f_.name.upper()}")
+            if env is not None:
+                setattr(sub, f_.name, _parse_scalar(env, type(getattr(sub, f_.name))))
+    validate(cfg)
+
+
+def validate(cfg: GigapaxosTpuConfig) -> None:
+    """Re-run dataclass validation (setattr bypasses ``__post_init__``)."""
+    for sub_name in ("paxos", "fd"):
+        sub = getattr(cfg, sub_name)
+        post = getattr(sub, "__post_init__", None)
+        if post is not None:
+            post()

@@ -1,0 +1,42 @@
+"""Ballot comparison kernels.
+
+A ballot is the totally ordered pair (ballotNumber, coordinatorID)
+(``gigapaxos/paxosutil/Ballot.java:34-73``).  The reference stores the two
+ints separately in the acceptor to save object overhead
+(``PaxosAcceptor.java:95-97``); we do the same with two ``int32`` arrays and
+compare lexicographically with branch-free arithmetic, which XLA fuses into
+the surrounding elementwise graph.  Slot comparison is two's-complement
+subtraction, wraparound-aware like the reference's ``a - b > 0`` idiom.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def bal_gt(an, ac, bn, bc):
+    """(an, ac) > (bn, bc) lexicographically; any broadcastable int32 arrays."""
+    return (an > bn) | ((an == bn) & (ac > bc))
+
+
+def bal_ge(an, ac, bn, bc):
+    return (an > bn) | ((an == bn) & (ac >= bc))
+
+
+def bal_eq(an, ac, bn, bc):
+    return (an == bn) & (ac == bc)
+
+
+def bal_max(an, ac, bn, bc):
+    """Elementwise lexicographic max of two ballots -> (num, coord)."""
+    take_a = bal_ge(an, ac, bn, bc)
+    return jnp.where(take_a, an, bn), jnp.where(take_a, ac, bc)
+
+
+def slot_after(a, b):
+    """True where slot a is logically after slot b (wraparound-aware)."""
+    return (a - b).astype(jnp.int32) > 0
+
+
+def slot_at_or_after(a, b):
+    return (a - b).astype(jnp.int32) >= 0

@@ -1,0 +1,59 @@
+"""Ring-buffer window ops over ``[..., G, W]`` arrays.
+
+The reference keeps per-group sparse maps ``acceptedProposals`` and
+``committedRequests`` keyed by slot (``PaxosAcceptor.java:108-115``) whose
+size is bounded in practice by the out-of-order arrival window.  Here each
+group owns a fixed ring of W slots: slot ``s`` lives at ring index
+``s & (W-1)`` and an entry is valid only for slots in
+``[exec_slot, exec_slot + W)``.  In-order extraction
+(``PaxosAcceptor.putAndRemoveNextExecutable``, PaxosAcceptor.java:325-366)
+becomes a leading-run count over the reordered window — branch-free, vmap- and
+MXU-friendly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def ring_index(slots, window: int):
+    """Ring index for (possibly wrapped) int32 slot numbers. W power of two."""
+    return jnp.bitwise_and(slots.astype(jnp.int32), jnp.int32(window - 1))
+
+
+def window_slots(exec_slot, window: int):
+    """``[..., W]`` array of the absolute slots covered by each group's window,
+    position j = exec_slot + j."""
+    ar = jnp.arange(window, dtype=jnp.int32)
+    return exec_slot[..., None] + ar
+
+
+def in_window(slots, exec_slot, window: int):
+    """True where ``slots`` fall inside [exec_slot, exec_slot+W) (wraparound-
+    aware)."""
+    d = (slots - exec_slot).astype(jnp.int32)
+    return (d >= 0) & (d < window)
+
+
+def gather_by_slot(arr, exec_slot, window: int):
+    """Reorder ring storage ``[..., G, W]`` so position j holds the entry for
+    slot exec_slot+j.  ``exec_slot`` has shape ``[..., G]``."""
+    idx = ring_index(window_slots(exec_slot, window), window)
+    return jnp.take_along_axis(arr, idx, axis=-1)
+
+
+def leading_run(valid):
+    """Number of leading True along the last axis (per group): how many
+    consecutive in-order entries are ready.  ``valid``: bool ``[..., W]``."""
+    return jnp.sum(jnp.cumprod(valid.astype(jnp.int32), axis=-1), axis=-1)
+
+
+def clear_below(arr, slot_of_entry, watermark, fill):
+    """Invalidate ring entries whose slot is below ``watermark``.
+
+    ``arr``: payload ``[..., G, W]``; ``slot_of_entry``: the absolute slot each
+    ring entry claims to hold ``[..., G, W]``; ``watermark``: ``[..., G]``.
+    Entries with slot < watermark are replaced by ``fill``.
+    """
+    stale = (slot_of_entry - watermark[..., None]).astype(jnp.int32) < 0
+    return jnp.where(stale, fill, arr)

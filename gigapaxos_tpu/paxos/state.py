@@ -1,0 +1,198 @@
+"""Dense device-resident Paxos state.
+
+One row per replica group, one leading axis per replica slot.  This is the
+TPU re-expression of the reference's per-group objects:
+
+* acceptor scalars (``PaxosAcceptor.java:94-101``: ``_slot``, ``ballotNum``,
+  ``ballotCoord``, ``acceptedGCSlot``, ``state``) -> ``int32`` arrays ``[R, G]``;
+* the sparse ``acceptedProposals`` / ``committedRequests`` maps
+  (``PaxosAcceptor.java:108-115``) -> ring windows ``[R, G, W]`` addressed by
+  ``slot & (W-1)``;
+* coordinator state (``PaxosCoordinatorState.java:69-144``: ballot, myProposals,
+  nextProposalSlot, waitfors) -> ``[R, G]`` scalars plus a proposal ring
+  ``[R, G, W]``; the WaitforUtility majority tally
+  (``paxosutil/WaitforUtility.java:34-68``) has no stored analog — it is
+  recomputed each tick as a popcount over the replica axis;
+* group membership -> a replicated bool mask ``[G, R]`` plus member count.
+
+Request payloads never enter the device: requests are ``int32`` ids handed out
+by the host (see ``paxos/manager.py``); the device orders ids, the host owns
+bytes.  ``NO_REQUEST`` (0) marks empty slots and no-op decisions.
+
+The replica axis doubles as the mesh axis ``replica`` when sharded (see
+``parallel/mesh.py``): reductions over axis 0 become ICI collectives under
+jit+GSPMD.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import (
+    GroupStatus,
+    INITIAL_BALLOT_COORD,
+    INITIAL_BALLOT_NUM,
+    NO_REQUEST,
+)
+
+I32 = jnp.int32
+BOOL = jnp.bool_
+
+
+class PaxosState(NamedTuple):
+    # ---- acceptor, per replica [R, G] ----
+    exec_slot: jnp.ndarray  # next slot to execute (== reference _slot)
+    bal_num: jnp.ndarray  # promised ballot number
+    bal_coord: jnp.ndarray  # promised ballot coordinator
+    status: jnp.ndarray  # GroupStatus per replica
+
+    # ---- accepted-pvalue ring [R, G, W] ----
+    acc_bnum: jnp.ndarray
+    acc_bcoord: jnp.ndarray
+    acc_req: jnp.ndarray
+    acc_slot: jnp.ndarray  # absolute slot the entry holds (validity check)
+    acc_stop: jnp.ndarray  # bool: pvalue is a stop request
+
+    # ---- decision ring [R, G, W] (last W learned decisions) ----
+    dec_req: jnp.ndarray
+    dec_slot: jnp.ndarray
+    dec_valid: jnp.ndarray
+    dec_stop: jnp.ndarray
+
+    # ---- coordinator, per replica [R, G] ----
+    coord_active: jnp.ndarray  # bool: majority promised my ballot
+    coord_preparing: jnp.ndarray  # bool: prepare issued, awaiting promises
+    coord_bnum: jnp.ndarray  # my ballot number (coordinator id == replica idx)
+    next_slot: jnp.ndarray  # next slot I will assign
+
+    # ---- coordinator proposal ring [R, G, W] (my in-flight phase-2 pvalues) ----
+    prop_req: jnp.ndarray
+    prop_slot: jnp.ndarray
+    prop_valid: jnp.ndarray
+    prop_stop: jnp.ndarray
+
+    # ---- group config, replicated [G, R] / [G] ----
+    member: jnp.ndarray  # bool [G, R]
+    n_members: jnp.ndarray  # int32 [G]
+    epoch: jnp.ndarray  # int32 [G]
+
+    @property
+    def n_replica_slots(self) -> int:
+        return self.exec_slot.shape[0]
+
+    @property
+    def n_groups(self) -> int:
+        return self.exec_slot.shape[1]
+
+    @property
+    def window(self) -> int:
+        return self.acc_req.shape[2]
+
+
+def init_state(n_replicas: int, n_groups: int, window: int) -> PaxosState:
+    """All rows FREE; groups are opened by `create_groups` below."""
+    R, G, W = n_replicas, n_groups, window
+
+    # Distinct buffers per field: the tick donates its input state, and XLA
+    # rejects donating one buffer through two arguments.
+    def z_rg():
+        return jnp.zeros((R, G), I32)
+
+    def f_rg():
+        return jnp.zeros((R, G), BOOL)
+
+    def f_rgw():
+        return jnp.zeros((R, G, W), BOOL)
+
+    return PaxosState(
+        exec_slot=z_rg(),
+        bal_num=jnp.full((R, G), INITIAL_BALLOT_NUM, I32),
+        bal_coord=jnp.full((R, G), INITIAL_BALLOT_COORD, I32),
+        status=jnp.full((R, G), int(GroupStatus.FREE), I32),
+        acc_bnum=jnp.full((R, G, W), INITIAL_BALLOT_NUM, I32),
+        acc_bcoord=jnp.full((R, G, W), INITIAL_BALLOT_COORD, I32),
+        acc_req=jnp.full((R, G, W), NO_REQUEST, I32),
+        acc_slot=jnp.full((R, G, W), -1, I32),
+        acc_stop=f_rgw(),
+        dec_req=jnp.full((R, G, W), NO_REQUEST, I32),
+        dec_slot=jnp.full((R, G, W), -1, I32),
+        dec_valid=f_rgw(),
+        dec_stop=f_rgw(),
+        coord_active=f_rg(),
+        coord_preparing=f_rg(),
+        coord_bnum=jnp.full((R, G), INITIAL_BALLOT_NUM, I32),
+        next_slot=z_rg(),
+        prop_req=jnp.full((R, G, W), NO_REQUEST, I32),
+        prop_slot=jnp.full((R, G, W), -1, I32),
+        prop_valid=f_rgw(),
+        prop_stop=f_rgw(),
+        member=jnp.zeros((G, R), BOOL),
+        n_members=jnp.zeros((G,), I32),
+        epoch=jnp.zeros((G,), I32),
+    )
+
+
+def create_groups(state: PaxosState, rows: np.ndarray, members: np.ndarray,
+                  epochs: np.ndarray | None = None) -> PaxosState:
+    """Open group rows (batched `createPaxosInstance`,
+    ``PaxosManager.java:611``).
+
+    rows: int32 [K] row indices; members: bool [K, R] member masks;
+    epochs: optional int32 [K].  Fresh groups start at slot 0, initial ballot,
+    ACTIVE status on every replica slot (non-members simply never contribute).
+    """
+    rows = jnp.asarray(rows, I32)
+    members = jnp.asarray(members, BOOL)
+    if epochs is None:
+        epochs = jnp.zeros((rows.shape[0],), I32)
+    else:
+        epochs = jnp.asarray(epochs, I32)
+    R, G, W = state.n_replica_slots, state.n_groups, state.window
+
+    def col(a, fill):  # reset per-replica [R, G] column at `rows`
+        return a.at[:, rows].set(fill)
+
+    def win(a, fill):  # reset [R, G, W] window at `rows`
+        return a.at[:, rows, :].set(fill)
+
+    return state._replace(
+        exec_slot=col(state.exec_slot, 0),
+        bal_num=col(state.bal_num, INITIAL_BALLOT_NUM),
+        bal_coord=col(state.bal_coord, INITIAL_BALLOT_COORD),
+        status=col(state.status, int(GroupStatus.ACTIVE)),
+        acc_bnum=win(state.acc_bnum, INITIAL_BALLOT_NUM),
+        acc_bcoord=win(state.acc_bcoord, INITIAL_BALLOT_COORD),
+        acc_req=win(state.acc_req, NO_REQUEST),
+        acc_slot=win(state.acc_slot, -1),
+        acc_stop=win(state.acc_stop, False),
+        dec_req=win(state.dec_req, NO_REQUEST),
+        dec_slot=win(state.dec_slot, -1),
+        dec_valid=win(state.dec_valid, False),
+        dec_stop=win(state.dec_stop, False),
+        coord_active=col(state.coord_active, False),
+        coord_preparing=col(state.coord_preparing, False),
+        coord_bnum=col(state.coord_bnum, INITIAL_BALLOT_NUM),
+        next_slot=col(state.next_slot, 0),
+        prop_req=win(state.prop_req, NO_REQUEST),
+        prop_slot=win(state.prop_slot, -1),
+        prop_valid=win(state.prop_valid, False),
+        prop_stop=win(state.prop_stop, False),
+        member=state.member.at[rows, :].set(members),
+        n_members=state.n_members.at[rows].set(
+            jnp.sum(members, axis=1).astype(I32)
+        ),
+        epoch=state.epoch.at[rows].set(epochs),
+    )
+
+
+def free_groups(state: PaxosState, rows: np.ndarray) -> PaxosState:
+    """Close group rows (kill/cremation analog, ``PaxosManager.java:2162``)."""
+    rows = jnp.asarray(rows, I32)
+    return state._replace(
+        status=state.status.at[:, rows].set(int(GroupStatus.FREE)),
+        member=state.member.at[rows, :].set(False),
+        n_members=state.n_members.at[rows].set(0),
+    )

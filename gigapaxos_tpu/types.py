@@ -1,0 +1,78 @@
+"""Core types for the TPU-native gigapaxos framework.
+
+The reference keeps one Java object per Paxos group
+(``gigapaxos/PaxosInstanceStateMachine.java:68-116``) with an acceptor whose
+entire hot state is five scalars plus two sparse maps
+(``gigapaxos/PaxosAcceptor.java:94-115``).  Here every scalar becomes a dense
+``int32`` array indexed by group row, and the sparse maps become fixed-width
+ring-buffer windows ``[G, W]``.  All protocol enums are plain ints so they can
+live inside traced JAX code.
+"""
+
+from __future__ import annotations
+
+import enum
+
+# ---------------------------------------------------------------------------
+# Group status (mirrors PaxosAcceptor.STATES, PaxosAcceptor.java:85-92, minus
+# the Java-lifecycle-specific RECOVERY distinction which our deterministic
+# replay recovery does not need as a device-visible state).
+# ---------------------------------------------------------------------------
+
+
+class GroupStatus(enum.IntEnum):
+    FREE = 0  # row unallocated
+    ACTIVE = 1  # normal operation
+    STOPPED = 2  # executed a stop request (end of epoch); rejects proposals
+
+
+# ---------------------------------------------------------------------------
+# Packet types for the host transport (Mode B / DCN path).  The reference
+# defines 17 JSON packet types (gigapaxos/paxospackets/PaxosPacket.java:202-291);
+# we keep a struct-of-arrays wire format and only the types that exist in the
+# dense protocol.  Values are stable wire ids.
+# ---------------------------------------------------------------------------
+
+
+class PacketType(enum.IntEnum):
+    REQUEST = 1  # client -> entry replica
+    PROPOSAL = 2  # entry replica -> coordinator
+    ACCEPT = 3  # coordinator -> acceptors (phase 2a)
+    ACCEPT_REPLY = 4  # acceptor -> coordinator (phase 2b)
+    DECISION = 5  # coordinator -> learners (phase 3)
+    PREPARE = 6  # would-be coordinator -> acceptors (phase 1a)
+    PREPARE_REPLY = 7  # acceptor -> would-be coordinator (phase 1b)
+    FAILURE_DETECT = 8  # keep-alive ping/pong
+    SYNC_DECISIONS = 9  # gap-sync request for missing commits
+    CHECKPOINT_STATE = 10  # checkpoint transfer (StatePacket analog)
+    RESPONSE = 11  # entry replica -> client
+    FIND_REPLICA_GROUP = 12
+    # chain replication (chainreplication/chainpackets/ChainPacket.java:119-133)
+    CHAIN_FORWARD = 20
+    CHAIN_ACK = 21
+    # reconfiguration control plane (subset; most RC traffic is host-level JSON)
+    RC_CONTROL = 30
+
+
+# Sentinel request id meaning "no request".  Real request ids start at 1.
+NO_REQUEST = 0
+
+# Sentinel node id meaning "nobody" (empty member slot / no coordinator).
+NO_NODE = -1
+
+# Initial ballot: the reference starts acceptors at ballot (-1, -1)
+# (PaxosAcceptor.java:95-97) so that any real ballot (0, c) wins.
+INITIAL_BALLOT_NUM = -1
+INITIAL_BALLOT_COORD = -1
+
+
+def slot_cmp(a: int, b: int) -> int:
+    """Wraparound-aware slot comparison (two's-complement subtraction), the
+    idiom used throughout the reference (e.g. PaxosAcceptor.java:289-291):
+    ``a - b > 0`` means a is logically after b even across int32 wraparound.
+    Host-side helper; device code uses jnp int32 subtraction directly.
+    """
+    d = (a - b) & 0xFFFFFFFF
+    if d == 0:
+        return 0
+    return 1 if d < 0x80000000 else -1

@@ -1,0 +1,23 @@
+"""Test harness setup: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's strategy of emulating a whole cluster inside one
+process (``TESTReconfigurationMain.startLocalServers``,
+reconfiguration/testing/TESTReconfigurationMain.java:86) — here the "machines"
+are virtual XLA CPU devices.
+
+Note: the dev image's sitecustomize registers a tunneled TPU backend and
+forces ``jax.config.jax_platforms = "axon,cpu"``; env vars alone cannot
+override that, so we update jax.config directly (before any jax op runs).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", os.environ.get("GPTPU_TEST_PLATFORM", "cpu"))

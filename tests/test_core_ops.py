@@ -1,0 +1,69 @@
+"""Unit tests for ballot/window primitives and the config registry."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from gigapaxos_tpu.config import GigapaxosTpuConfig, load_properties
+from gigapaxos_tpu.ops import ballot as b
+from gigapaxos_tpu.ops import window as w
+from gigapaxos_tpu.types import slot_cmp
+
+
+def test_ballot_lexicographic():
+    an = jnp.array([2, 1, 1, 0])
+    ac = jnp.array([0, 5, 5, 9])
+    bn = jnp.array([1, 1, 1, 1])
+    bc = jnp.array([9, 5, 6, 0])
+    assert list(np.array(b.bal_gt(an, ac, bn, bc))) == [True, False, False, False]
+    assert list(np.array(b.bal_ge(an, ac, bn, bc))) == [True, True, False, False]
+    mn, mc = b.bal_max(an, ac, bn, bc)
+    assert list(np.array(mn)) == [2, 1, 1, 1]
+    assert list(np.array(mc)) == [0, 5, 6, 0]
+
+
+def test_slot_wraparound():
+    big = jnp.int32(2**31 - 2)
+    assert bool(b.slot_after(big + 3, big))  # wraps negative, still "after"
+    assert slot_cmp(-(2**31) + 1, 2**31 - 2) == 1
+
+
+def test_window_ring_and_leading_run():
+    W = 8
+    exec_slot = jnp.array([[5]])
+    slots = w.window_slots(exec_slot, W)
+    assert list(np.array(slots)[0, 0]) == list(range(5, 13))
+    assert list(np.array(w.ring_index(slots, W))[0, 0]) == [5, 6, 7, 0, 1, 2, 3, 4]
+    valid = jnp.array([[True, True, False, True]])
+    assert int(w.leading_run(valid)[0]) == 2
+
+
+def test_config_properties_roundtrip(tmp_path):
+    p = tmp_path / "gigapaxos.properties"
+    p.write_text(
+        """# topology (same format as the reference's gigapaxos.properties)
+active.AR0=127.0.0.1:2000
+active.AR1=127.0.0.1:2001
+reconfigurator.RC0=127.0.0.1:3000
+paxos.window=16
+paxos.max_groups=4096
+fd.timeout_s=5.5
+"""
+    )
+    cfg = load_properties(str(p))
+    assert cfg.nodes.actives == {
+        "AR0": ("127.0.0.1", 2000),
+        "AR1": ("127.0.0.1", 2001),
+    }
+    assert cfg.nodes.reconfigurator_ids() == ["RC0"]
+    assert cfg.paxos.window == 16
+    assert cfg.paxos.max_groups == 4096
+    assert cfg.fd.timeout_s == 5.5
+
+
+def test_config_window_power_of_two():
+    import pytest
+
+    with pytest.raises(ValueError):
+        from gigapaxos_tpu.config import PaxosTuning
+
+        PaxosTuning(window=12)

@@ -1,0 +1,126 @@
+"""Randomized safety/liveness property tests (the sanitizer analog).
+
+The reference relies on Java assertions run with ``-ea`` (e.g. the
+non-conflicting-accept assert, PaxosAcceptor.java:306-308, and slot invariant
+:387-391).  Here we drive the whole dense data plane through random request
+arrivals and random crash/recover schedules and check the global Paxos
+invariants from the outside:
+
+  S1 (agreement): for every group and slot, every replica that executes that
+     slot executes the same request id.
+  S2 (prefix order): each replica's executed sequence is a prefix of the
+     longest executed sequence for that group.
+  S3 (no dup slots): no replica executes a slot twice.
+  L1 (liveness): with a majority continuously alive, submitted requests
+     eventually execute.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from gigapaxos_tpu.ops.tick import TickInbox, paxos_tick
+from gigapaxos_tpu.paxos import state as st
+
+
+def run_random(seed, R=3, G=8, W=8, P=2, ticks=60, crash_prob=0.15,
+               majority_guard=True):
+    rng = np.random.default_rng(seed)
+    s = st.init_state(R, G, W)
+    s = st.create_groups(s, np.arange(G, dtype=np.int32), np.ones((G, R), bool))
+
+    executed = [[dict() for _ in range(G)] for _ in range(R)]  # slot -> req
+    submitted = [set() for _ in range(G)]
+    pending = [[] for _ in range(G)]
+    next_rid = 1
+    alive = np.ones(R, bool)
+
+    for t in range(ticks):
+        # random crash/recover, optionally keeping a majority alive
+        for r in range(R):
+            if rng.random() < crash_prob:
+                alive[r] = not alive[r]
+        if majority_guard and alive.sum() < R // 2 + 1:
+            alive[:] = True
+
+        req = np.zeros((R, G, P), np.int32)
+        stp = np.zeros((R, G, P), bool)
+        for g in range(G):
+            # retry pending (rejected intake) first, then maybe a new request
+            if rng.random() < 0.5:
+                pending[g].append(next_rid)
+                submitted[g].add(next_rid)
+                next_rid += 1
+            live = [r for r in range(R) if alive[r]]
+            for p, rid in enumerate(pending[g][: P]):
+                r = rng.choice(live) if live else 0
+                req[r, g, p % P] = rid
+        ib = TickInbox(jnp.asarray(req), jnp.asarray(stp), jnp.asarray(alive.copy()))
+        s, out = paxos_tick(s, ib)
+
+        taken = np.array(out.intake_taken)
+        for g in range(G):
+            kept = []
+            for p, rid in enumerate(pending[g][: P]):
+                placed = False
+                for r in range(R):
+                    if req[r, g, p % P] == rid and taken[r, g, p % P]:
+                        placed = True
+                if not placed:
+                    kept.append(rid)
+            pending[g] = kept + pending[g][P:]
+
+        er = np.array(out.exec_req)
+        eb = np.array(out.exec_base)
+        ec = np.array(out.exec_count)
+        for r in range(R):
+            for g in range(G):
+                for j in range(int(ec[r, g])):
+                    slot = int(eb[r, g]) + j
+                    rid = int(er[r, g, j])
+                    assert slot not in executed[r][g], (
+                        f"S3 violated: r{r} g{g} slot {slot} twice"
+                    )
+                    executed[r][g][slot] = rid
+
+    # S1/S2: per-slot agreement and prefix consistency
+    for g in range(G):
+        merged = {}
+        for r in range(R):
+            for slot, rid in executed[r][g].items():
+                if slot in merged:
+                    assert merged[slot] == rid, (
+                        f"S1 violated: g{g} slot {slot}: {merged[slot]} vs {rid}"
+                    )
+                merged[slot] = rid
+            if executed[r][g]:
+                slots = sorted(executed[r][g])
+                assert slots == list(range(slots[0] + len(slots)))[slots[0]:], (
+                    f"S2 violated: r{r} g{g} has gaps: {slots}"
+                )
+                assert slots[0] == 0
+    return s, executed, submitted, pending
+
+
+def test_random_crash_recover_safety():
+    for seed in range(6):
+        run_random(seed)
+
+
+def test_liveness_all_alive():
+    s, executed, submitted, pending = run_random(
+        seed=99, crash_prob=0.0, ticks=40
+    )
+    for g, subs in enumerate(submitted):
+        done = set(executed[0][g].values())
+        missing = subs - done - set(pending[g])
+        assert not missing, f"L1 violated: g{g} lost {missing}"
+        assert len(done) >= len(subs) - 2  # at most the last couple in flight
+
+
+def test_noop_decisions_allowed():
+    """Failover may commit noop fillers; executed req id 0 means 'skip' and
+    must never collide with a real request id."""
+    for seed in (3, 7):
+        _, executed, _, _ = run_random(seed, crash_prob=0.3, ticks=50)
+        # merged histories stay consistent even with noops present
+        # (assertions inside run_random cover S1-S3)

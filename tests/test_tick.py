@@ -1,0 +1,216 @@
+"""Unit tests for the fused consensus tick (the vmapped data plane).
+
+Covers the behaviors the reference exercises through
+PaxosInstanceStateMachine's packet handlers: bootstrap election, single- and
+multi-decree commit, out-of-order-free in-order execution, stop requests,
+minority/majority liveness, coordinator failover with carryover, and laggard
+resync.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from gigapaxos_tpu.ops.tick import TickInbox, make_inbox, paxos_tick
+from gigapaxos_tpu.paxos import state as st
+from gigapaxos_tpu.types import GroupStatus
+
+
+def mk(R=3, G=4, W=8, members=None):
+    s = st.init_state(R, G, W)
+    if members is None:
+        members = np.ones((G, R), bool)
+    rows = np.arange(G, dtype=np.int32)
+    return st.create_groups(s, rows, members)
+
+
+def inbox(R=3, G=4, P=4, reqs=(), stops=(), alive=None):
+    """reqs: list of (replica, group, reqid)."""
+    ib = make_inbox(R, G, P)
+    req = np.array(ib.req)
+    stp = np.array(ib.stop)
+    slot_ctr = {}
+    for r, g, rid in reqs:
+        p = slot_ctr.get((r, g), 0)
+        req[r, g, p] = rid
+        slot_ctr[(r, g)] = p + 1
+    for r, g, rid in stops:
+        p = slot_ctr.get((r, g), 0)
+        req[r, g, p] = rid
+        stp[r, g, p] = True
+        slot_ctr[(r, g)] = p + 1
+    al = np.ones(R, bool) if alive is None else np.array(alive, bool)
+    return TickInbox(jnp.asarray(req), jnp.asarray(stp), jnp.asarray(al))
+
+
+def executed_ids(out, r, g):
+    row = np.array(out.exec_req[r, g])
+    n = int(out.exec_count[r, g])
+    return [int(x) for x in row if x != 0][: n + 1]
+
+
+def test_bootstrap_elects_coordinator():
+    s = mk()
+    s, out = paxos_tick(s, inbox())
+    # first live member (replica 0) becomes coordinator of every group
+    assert np.all(np.array(out.coord_id) == 0)
+    assert np.all(np.array(s.coord_active[0]))
+    assert not np.any(np.array(s.coord_preparing))
+
+
+def test_single_request_commits_in_one_tick():
+    s = mk()
+    s, out = paxos_tick(s, inbox(reqs=[(1, 2, 77)]))
+    # executed at every replica, same slot
+    for r in range(3):
+        assert executed_ids(out, r, 2) == [77]
+    assert np.all(np.array(s.exec_slot[:, 2]) == 1)
+    assert np.array(out.intake_taken[1, 2, 0])
+    # other groups idle
+    assert int(out.exec_count[0, 0]) == 0
+
+
+def test_multi_request_fifo_order_across_replicas():
+    s = mk()
+    ib = inbox(reqs=[(0, 1, 11), (0, 1, 12), (2, 1, 13)])
+    s, out = paxos_tick(s, ib)
+    seq0 = executed_ids(out, 0, 1)
+    assert sorted(seq0) == [11, 12, 13]
+    for r in (1, 2):
+        assert executed_ids(out, r, 1) == seq0  # identical order everywhere
+    assert np.all(np.array(s.exec_slot[:, 1]) == 3)
+
+
+def test_throughput_across_ticks_monotonic_slots():
+    s = mk(G=2)
+    rid = 1
+    total = 0
+    for _ in range(5):
+        reqs = [(rid % 3, 0, rid + 100)]
+        rid += 1
+        s, out = paxos_tick(s, inbox(G=2, reqs=reqs))
+        total += int(out.exec_count[0, 0])
+    assert total == 5
+    assert int(s.exec_slot[0, 0]) == 5
+
+
+def test_stop_request_stops_group():
+    s = mk()
+    s, out = paxos_tick(s, inbox(stops=[(0, 3, 55)]))
+    assert executed_ids(out, 0, 3) == [55]
+    assert np.all(np.array(out.exec_stop[0, 3])[:1])
+    assert np.all(np.array(s.status[:, 3]) == int(GroupStatus.STOPPED))
+    # further proposals rejected
+    s, out = paxos_tick(s, inbox(reqs=[(0, 3, 56)]))
+    assert int(out.exec_count[0, 3]) == 0
+    assert not np.array(out.intake_taken[0, 3, 0])
+
+
+def test_no_quorum_with_minority_alive():
+    s = mk()
+    alive = [True, False, False]
+    s, out = paxos_tick(s, inbox(reqs=[(0, 0, 9)], alive=alive))
+    assert int(out.exec_count[0, 0]) == 0  # 1 of 3 cannot commit
+
+
+def test_majority_suffices():
+    s = mk()
+    alive = [True, True, False]
+    s, out = paxos_tick(s, inbox(reqs=[(0, 0, 9)], alive=alive))
+    assert executed_ids(out, 0, 0) == [9]
+    assert executed_ids(out, 1, 0) == [9]
+    assert int(out.exec_count[2, 0]) == 0  # dead replica frozen
+
+
+def test_coordinator_failover_elects_next_live():
+    s = mk()
+    s, _ = paxos_tick(s, inbox())  # replica 0 coordinator
+    alive = [False, True, True]
+    s, out = paxos_tick(s, inbox(alive=alive))
+    assert np.all(np.array(out.coord_id) == 1)
+    s, out = paxos_tick(s, inbox(reqs=[(1, 0, 42)], alive=alive))
+    assert executed_ids(out, 1, 0) == [42]
+    assert executed_ids(out, 2, 0) == [42]
+
+
+def test_failover_carryover_preserves_committed_value():
+    """A value decided under the old coordinator survives failover (the
+    combinePValuesOntoProposals safety property)."""
+    s = mk()
+    s, out = paxos_tick(s, inbox(reqs=[(0, 0, 31)]))
+    assert executed_ids(out, 0, 0) == [31]
+    # kill old coordinator; propose under the new one; slots must not collide
+    alive = [False, True, True]
+    s, out = paxos_tick(s, inbox(reqs=[(1, 0, 32)], alive=alive))
+    assert executed_ids(out, 1, 0) == [32]
+    assert int(s.exec_slot[1, 0]) == 2  # slot 0: 31, slot 1: 32
+
+
+def test_dead_replica_rejoins_and_catches_up():
+    s = mk()
+    alive = [True, True, False]
+    for rid in (1, 2, 3):
+        s, out = paxos_tick(s, inbox(reqs=[(0, 0, rid)], alive=alive))
+    assert int(s.exec_slot[2, 0]) == 0
+    # rejoin: replica 2 adopts decisions still in peers' rings (gap < W)
+    s, out = paxos_tick(s, inbox())
+    assert int(s.exec_slot[2, 0]) == 3
+    assert executed_ids(out, 2, 0) == [1, 2, 3]
+
+
+def test_groups_are_independent():
+    s = mk()
+    ib = inbox(reqs=[(0, 0, 5), (1, 1, 6)])
+    s, out = paxos_tick(s, ib)
+    assert executed_ids(out, 0, 0) == [5]
+    assert executed_ids(out, 0, 1) == [6]
+    assert int(out.exec_count[0, 2]) == 0
+
+
+def test_free_rows_do_nothing():
+    s = st.init_state(3, 4, 8)  # nothing created
+    s, out = paxos_tick(s, inbox())
+    assert not np.any(np.array(out.exec_count))
+    assert np.all(np.array(out.coord_id) == -1)
+
+
+def test_window_backpressure():
+    """More intake than window space: only W fit, rest rejected for retry."""
+    s = mk(G=1)
+    reqs = [(r, 0, 100 + r * 10 + p) for r in range(3) for p in range(4)]
+    ib = inbox(G=1, reqs=reqs)
+    s, out = paxos_tick(s, ib)
+    taken = int(np.sum(np.array(out.intake_taken)))
+    assert taken == 8  # window W=8
+    assert int(out.exec_count[0, 0]) == 8
+
+
+def test_stop_learned_by_replica_that_missed_it():
+    """Regression: a replica dead when the stop committed must still learn it
+    from stopped peers after rejoining (serve_ok includes STOPPED)."""
+    s = mk()
+    alive = [True, True, False]
+    s, out = paxos_tick(s, inbox(stops=[(0, 0, 50)], alive=alive))
+    assert int(s.status[0, 0]) == int(GroupStatus.STOPPED)
+    assert int(s.status[2, 0]) == int(GroupStatus.ACTIVE)
+    # rejoin: replica 2 must adopt the stop decision and stop too
+    for _ in range(3):
+        s, out = paxos_tick(s, inbox())
+    assert int(s.status[2, 0]) == int(GroupStatus.STOPPED)
+    assert int(s.exec_slot[2, 0]) == 1
+
+
+def test_lag_reported_beyond_window():
+    """Regression: a replica > W behind must report its gap so the host can
+    run a checkpoint transfer; ring sync alone cannot catch it up."""
+    s = mk(G=1)
+    alive = [True, True, False]
+    rid = 1
+    for _ in range(3):  # 3 ticks x 4 reqs = 12 > W=8
+        reqs = [(0, 0, rid + i) for i in range(4)]
+        rid += 4
+        s, out = paxos_tick(s, inbox(G=1, reqs=reqs, alive=alive))
+    assert int(s.exec_slot[0, 0]) == 12
+    s, out = paxos_tick(s, inbox(G=1))
+    assert int(out.lag[2, 0]) >= 8  # host's signal for checkpoint transfer
+    # and the stuck laggard must not capture the coordinatorship
+    assert int(out.coord_id[0]) in (0, 1)

@@ -1,0 +1,381 @@
+"""PaxosManager: the host control loop that owns the device data plane.
+
+The reference's ``PaxosManager`` (gigapaxos/PaxosManager.java:104-119) is the
+per-node multiplexer: instance map, request demultiplexing, the propose API,
+recovery driver and pause logic.  Here it owns:
+
+* the dense device state (one :class:`PaxosState`) and the jitted tick;
+* the name<->row table (RowAllocator = IntegerMap/MultiArrayMap analog,
+  paxosutil/IntegerMap.java:40 / utils/MultiArrayMap.java:41);
+* the request store: request-id -> payload/callback (the ``outstanding`` map,
+  PaxosManager.java:189-259), with execution-side dedup so a request that
+  commits in two slots (possible across coordinator turnover, the
+  "preempted request" hazard of PaxosManager.java:1298-1352) executes once;
+* per-replica-slot app instances (``Replicable``), executed on the host from
+  the device's ordered decision stream;
+* the per-tick batcher (RequestBatcher analog, gigapaxos/RequestBatcher.java:25):
+  queued proposals are packed into the inbox tensor, rejected intake is
+  re-queued.
+
+This manager drives the whole replica set of a mesh (Mode A).  In a
+multi-host deployment each host runs one manager per node and the replica
+axis exchange goes over the transport instead (net/, Mode B).
+"""
+
+from __future__ import annotations
+
+import collections
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import GigapaxosTpuConfig
+from ..models.replicable import Replicable
+from ..types import GroupStatus, NO_REQUEST
+from ..utils.intmap import RowAllocator
+from . import state as st
+from ..ops.tick import TickInbox, TickOutbox, paxos_tick
+
+
+@dataclass
+class RequestRecord:
+    rid: int
+    name: str
+    row: int
+    payload: bytes
+    stop: bool
+    callback: Optional[Callable[[int, bytes], None]]
+    entry: int  # entry replica slot
+    slot: int = -1  # filled at first execution
+    executed_by: set = field(default_factory=set)
+    responded: bool = False
+
+
+class PaxosManager:
+    def __init__(
+        self,
+        cfg: GigapaxosTpuConfig,
+        n_replicas: int,
+        apps: List[Replicable],
+        wal=None,
+    ):
+        assert len(apps) == n_replicas
+        self.cfg = cfg
+        self.R = n_replicas
+        self.G = cfg.paxos.max_groups
+        self.W = cfg.paxos.window
+        self.P = cfg.paxos.proposals_per_tick
+        self.state = st.init_state(self.R, self.G, self.W)
+        self.rows = RowAllocator(self.G)
+        self.apps = apps
+        self.wal = wal
+        self.alive = np.ones(self.R, bool)
+        self.tick_num = 0
+        self.outstanding: Dict[int, RequestRecord] = {}
+        self._next_rid = 1
+        self._queues: Dict[int, collections.deque] = collections.defaultdict(
+            collections.deque
+        )  # row -> rids waiting for intake
+        # callbacks held until the WAL record covering their tick is fsynced
+        # (log-before-respond, the analog of logAndMessage's log-before-send,
+        # AbstractPaxosLogger.java:157-178)
+        self._held_callbacks: list = []
+        # per (replica, row) dedup of executed request ids (bounded)
+        self._seen: Dict[tuple, collections.OrderedDict] = collections.defaultdict(
+            collections.OrderedDict
+        )
+        self._seen_cap = 8 * self.W
+        self.stats = collections.Counter()
+        self._stopped_rows: set[int] = set()
+        if self.wal is not None:
+            self.wal.attach(self)
+
+    # ------------------------------------------------------------------ admin
+    def create_paxos_instance(
+        self, name: str, members: List[int], epoch: int = 0
+    ) -> bool:
+        """createPaxosInstance analog (PaxosManager.java:611)."""
+        if name in self.rows:
+            return False
+        row = self.rows.alloc(name)
+        mask = np.zeros((1, self.R), bool)
+        for m in members:
+            mask[0, m] = True
+        self.state = st.create_groups(
+            self.state,
+            np.array([row], np.int32),
+            mask,
+            np.array([epoch], np.int32),
+        )
+        self._stopped_rows.discard(row)
+        if self.wal is not None:
+            self.wal.log_create(name, members, epoch)
+        return True
+
+    def remove_paxos_instance(self, name: str) -> bool:
+        """kill/cremation analog (PaxosManager.java:2162-2205)."""
+        row = self.rows.row(name)
+        if row is None:
+            return False
+        self.state = st.free_groups(self.state, np.array([row], np.int32))
+        self.rows.free(name)
+        self._fail_queued(row)
+        self._stopped_rows.discard(row)
+        if self.wal is not None:
+            self.wal.log_remove(name)
+        return True
+
+    def group_members(self, name: str) -> Optional[List[int]]:
+        row = self.rows.row(name)
+        if row is None:
+            return None
+        return [int(r) for r in np.where(np.array(self.state.member[row]))[0]]
+
+    def is_stopped(self, name: str) -> bool:
+        row = self.rows.row(name)
+        return row is not None and row in self._stopped_rows
+
+    # ---------------------------------------------------------------- propose
+    def propose(
+        self,
+        name: str,
+        payload: bytes,
+        callback: Optional[Callable[[int, bytes], None]] = None,
+        stop: bool = False,
+        entry: Optional[int] = None,
+    ) -> Optional[int]:
+        """propose/proposeStop analog (PaxosManager.java:1214-1288).
+
+        Returns the request id, or None if the group is unknown.
+        """
+        row = self.rows.row(name)
+        if row is None:
+            return None
+        if row in self._stopped_rows:
+            # stopped epoch: fail fast so the client can re-resolve actives
+            if callback is not None:
+                self._held_callbacks.append((callback, -1, None))
+            self.stats["failed_requests"] += 1
+            return None
+        rid = self._next_rid
+        self._next_rid += 1
+        members = np.where(np.array(self.state.member[row]))[0]
+        if entry is None or entry not in members:
+            # spread entry replicas across the group's members (not the whole
+            # replica set — a non-member never executes, so its callback
+            # would be orphaned)
+            entry = int(members[rid % len(members)]) if len(members) else 0
+        rec = RequestRecord(rid, name, row, payload, stop, callback, entry)
+        self.outstanding[rid] = rec
+        self._queues[row].append(rid)
+        return rid
+
+    def propose_stop(self, name: str, payload: bytes = b"", callback=None):
+        return self.propose(name, payload, callback, stop=True)
+
+    def _fail_queued(self, row: int) -> None:
+        """Fail queued-but-never-committed requests for a stopped/removed
+        group: fire callbacks with response None (client retries elsewhere,
+        as the reference's clients do on an inactive-epoch error)."""
+        q = self._queues.pop(row, None)
+        if not q:
+            return
+        for rid in q:
+            rec = self.outstanding.pop(rid, None)
+            if rec is not None and rec.callback is not None and not rec.responded:
+                self._held_callbacks.append((rec.callback, rid, None))
+            self.stats["failed_requests"] += 1
+
+    # ------------------------------------------------------------------- tick
+    def _build_inbox(self) -> TickInbox:
+        req = np.zeros((self.R, self.G, self.P), np.int32)
+        stp = np.zeros((self.R, self.G, self.P), bool)
+        placed = []
+        for row, q in self._queues.items():
+            used = collections.Counter()
+            take = []
+            while q and len(take) < self.P:
+                rid = q.popleft()
+                rec = self.outstanding.get(rid)
+                if rec is None:
+                    continue
+                if not self.alive[rec.entry]:
+                    # re-home the request to a live *member* so the response
+                    # callback is not orphaned on a dead entry node
+                    ms = np.where(np.array(self.state.member[row]))[0]
+                    live = [m for m in ms if self.alive[m]]
+                    if not live:
+                        q.appendleft(rid)
+                        break
+                    rec.entry = int(live[0])
+                entry = rec.entry
+                p = used[entry]
+                if p >= self.P:
+                    q.appendleft(rid)
+                    break
+                used[entry] += 1
+                req[entry, row, p] = rid
+                stp[entry, row, p] = rec.stop
+                take.append((rid, entry, p))
+            placed.append((row, take))
+        self._placed = placed
+        return TickInbox(
+            jnp.asarray(req), jnp.asarray(stp), jnp.asarray(self.alive.copy())
+        )
+
+    def tick(self) -> TickOutbox:
+        inbox = self._build_inbox()
+        if self.wal is not None:
+            self.wal.log_inbox(self.tick_num, inbox)
+        self.state, out = paxos_tick(self.state, inbox)
+        self._process_outbox(out)
+        self.tick_num += 1
+        if self.wal is not None:
+            self.wal.maybe_checkpoint()
+        self._flush_callbacks()
+        if self.tick_num % 64 == 0:
+            self._sweep_outstanding()
+        return out
+
+    def _flush_callbacks(self) -> None:
+        """Release client responses only once the WAL covering their tick is
+        durable (log-before-respond; with sync_every_ticks > 1 responses ride
+        the next group commit)."""
+        if not self._held_callbacks:
+            return
+        if self.wal is not None and not self.wal.is_synced():
+            return
+        held, self._held_callbacks = self._held_callbacks, []
+        for cb, rid, resp in held:
+            cb(rid, resp)
+
+    def _process_outbox(self, out: TickOutbox) -> None:
+        taken = np.array(out.intake_taken)
+        for row, take in self._placed:
+            for rid, entry, p in reversed(take):
+                if not taken[entry, row, p] and rid in self.outstanding:
+                    self._queues[row].appendleft(rid)  # retry next tick
+        er = np.array(out.exec_req)
+        es = np.array(out.exec_stop)
+        eb = np.array(out.exec_base)
+        ec = np.array(out.exec_count)
+        active = np.where(np.array(out.exec_count).sum(axis=0) > 0)[0] if ec.any() else []
+        for row in active:
+            name = self.rows.name(int(row))
+            if name is None:
+                continue
+            for r in range(self.R):
+                n = int(ec[r, row])
+                for j in range(n):
+                    rid = int(er[r, row, j])
+                    slot = int(eb[r, row]) + j
+                    is_stop = bool(es[r, row, j])
+                    self._execute_one(r, int(row), name, rid, slot, is_stop)
+        self.stats["decisions"] += int(np.array(out.decided_now).sum())
+
+    def _execute_one(self, r: int, row: int, name: str, rid: int, slot: int,
+                     is_stop: bool) -> None:
+        if is_stop and row not in self._stopped_rows:
+            self._stopped_rows.add(row)
+            self._fail_queued(row)  # nothing after a stop can ever commit
+        if rid == NO_REQUEST:
+            self.stats["noops"] += 1
+            return
+        seen = self._seen[(r, row)]
+        if rid in seen:
+            self.stats["dup_commits"] += 1
+            return
+        seen[rid] = slot
+        while len(seen) > self._seen_cap:
+            seen.popitem(last=False)
+        rec = self.outstanding.get(rid)
+        if rec is None:
+            self.stats["orphan_execs"] += 1  # payload GC'd (laggard)
+            return
+        rec.slot = slot
+        response = self.apps[r].execute(name, rec.payload, rid)
+        rec.executed_by.add(r)
+        self.stats["executions"] += 1
+        if r == rec.entry and not rec.responded:
+            rec.responded = True
+            if rec.callback is not None:
+                self._held_callbacks.append((rec.callback, rid, response))
+        members = int(self.state.n_members[row])
+        if len(rec.executed_by) >= members and rec.responded:
+            del self.outstanding[rid]
+
+    def _sweep_outstanding(self) -> None:
+        """Drop responded records whose slot every live member has passed
+        (laggards that far behind catch up by checkpoint transfer, not
+        replay, so the payload is no longer needed)."""
+        if not self.outstanding:
+            return
+        exec_slot = np.array(self.state.exec_slot)
+        member = np.array(self.state.member)
+        dead = []
+        for rid, rec in self.outstanding.items():
+            if not rec.responded or rec.slot < 0:
+                continue
+            ms = np.where(member[rec.row])[0]
+            live = [m for m in ms if self.alive[m]]
+            if live and all(exec_slot[m, rec.row] > rec.slot for m in live):
+                dead.append(rid)
+        for rid in dead:
+            del self.outstanding[rid]
+            self.stats["swept"] += 1
+
+    # --------------------------------------------------------------- liveness
+    def set_alive(self, r: int, up: bool) -> None:
+        self.alive[r] = up
+
+    def sync_laggard(self, r: int, name: str) -> bool:
+        """Checkpoint transfer for a replica lagging >= W on a group
+        (StatePacket/handleCheckpoint analog,
+        PaxosInstanceStateMachine.java:1852-1861): copy exec watermark from
+        the most advanced live member and restore its app state.
+        """
+        row = self.rows.row(name)
+        if row is None:
+            return False
+        exec_slot = np.array(self.state.exec_slot[:, row])
+        members = np.where(np.array(self.state.member[row]))[0]
+        donors = [m for m in members if self.alive[m] and m != r]
+        if not donors:
+            return False
+        donor = max(donors, key=lambda m: exec_slot[m])
+        if exec_slot[donor] <= exec_slot[r]:
+            return False
+        ckpt = self.apps[donor].checkpoint(name)
+        self.apps[r].restore(name, ckpt)
+        self.state = self.state._replace(
+            exec_slot=self.state.exec_slot.at[r, row].set(int(exec_slot[donor])),
+            status=self.state.status.at[r, row].set(
+                int(self.state.status[donor, row])
+            ),
+        )
+        self._seen.pop((r, row), None)
+        self.stats["checkpoint_transfers"] += 1
+        return True
+
+    def auto_sync_laggards(self, out: TickOutbox) -> int:
+        """Scan the lag signal and run checkpoint transfers where ring sync
+        cannot catch up (lag >= W)."""
+        lag = np.array(out.lag)
+        n = 0
+        for r, row in zip(*np.where(lag >= self.W)):
+            if not self.alive[r]:
+                continue
+            name = self.rows.name(int(row))
+            if name and self.sync_laggard(int(r), name):
+                n += 1
+        return n
+
+    # ------------------------------------------------------------ conveniences
+    def run_ticks(self, n: int) -> None:
+        for _ in range(n):
+            self.tick()
+
+    def pending_count(self) -> int:
+        return sum(len(q) for q in self._queues.values())

@@ -1,0 +1,58 @@
+"""Name <-> dense-row interning.
+
+The reference interns arbitrary ``NodeIDType`` objects to ints for all
+internal soft state (``paxosutil/IntegerMap.java:40``) and stores millions of
+instances in an open-addressed multi-array map (``utils/MultiArrayMap.java:41``).
+In the dense-array design the analog is row allocation: every paxos group name
+gets a row index into the ``[G]`` state arrays; freed rows are recycled.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+
+class RowAllocator:
+    """Allocates dense row indices for string names, with recycling."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._name_to_row: Dict[str, int] = {}
+        self._row_to_name: Dict[int, str] = {}
+        self._free: list[int] = list(range(capacity - 1, -1, -1))
+
+    def __len__(self) -> int:
+        return len(self._name_to_row)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._name_to_row
+
+    def alloc(self, name: str) -> int:
+        if name in self._name_to_row:
+            raise KeyError(f"{name!r} already allocated")
+        if not self._free:
+            raise MemoryError(
+                f"group table full ({self.capacity}); raise paxos.max_groups"
+            )
+        row = self._free.pop()
+        self._name_to_row[name] = row
+        self._row_to_name[row] = name
+        return row
+
+    def row(self, name: str) -> Optional[int]:
+        return self._name_to_row.get(name)
+
+    def name(self, row: int) -> Optional[str]:
+        return self._row_to_name.get(row)
+
+    def free(self, name: str) -> int:
+        row = self._name_to_row.pop(name)
+        del self._row_to_name[row]
+        self._free.append(row)
+        return row
+
+    def names(self) -> Iterator[str]:
+        return iter(self._name_to_row)
+
+    def items(self):
+        return self._name_to_row.items()

@@ -1,0 +1,90 @@
+"""Benchmark: sustained decisions/sec/chip on the dense consensus engine.
+
+Reproduces the reference's capacity-probe methodology
+(``TESTPaxosConfig.java:190-229``: drive load, measure sustained decision
+throughput) at the BASELINE.json north-star configuration: 1M concurrent
+3-replica Paxos groups on one chip, one request per group per tick.
+
+Load generation runs on-device (the analog of the in-JVM TESTPaxosClient) so
+the measurement is the consensus engine, not host Python.  Prints ONE JSON
+line: {"metric", "value", "unit", "vs_baseline"}.
+
+Env knobs: GPTPU_BENCH_GROUPS (default 1<<20), GPTPU_BENCH_TICKS (default 30),
+GPTPU_BENCH_REPLICAS (3), GPTPU_BENCH_WINDOW (8).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+BASELINE_DECISIONS_PER_SEC = 100_000.0  # north star: >=100k dec/s/chip
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from gigapaxos_tpu.ops.tick import TickInbox, paxos_tick_impl
+    from gigapaxos_tpu.paxos import state as st
+
+    R = int(os.environ.get("GPTPU_BENCH_REPLICAS", 3))
+    G = int(os.environ.get("GPTPU_BENCH_GROUPS", 1 << 20))
+    W = int(os.environ.get("GPTPU_BENCH_WINDOW", 8))
+    P = 1
+    n_ticks = int(os.environ.get("GPTPU_BENCH_TICKS", 30))
+
+    state = st.init_state(R, G, W)
+    state = st.create_groups(
+        state, np.arange(G, dtype=np.int32), np.ones((G, R), bool)
+    )
+
+    def step(state, rid_base):
+        # on-device load generator: every group gets one fresh request id per
+        # tick at entry replica (g % R)
+        g = jnp.arange(G, dtype=jnp.int32)
+        rids = rid_base + g
+        req = jnp.zeros((R, P, G), jnp.int32)
+        req = req.at[:, 0, :].set(
+            jnp.where(g[None, :] % R == jnp.arange(R)[:, None], rids[None, :], 0)
+        )
+        inbox = TickInbox(
+            req, jnp.zeros((R, P, G), jnp.bool_), jnp.ones((R,), jnp.bool_)
+        )
+        new_state, out = paxos_tick_impl(state, inbox)
+        return new_state, jnp.sum(out.decided_now)
+
+    def step_acc(state, acc, rid_base):
+        # decisions accumulate on device; the host reads one scalar at the end
+        state, d = step(state, rid_base)
+        return state, acc + d
+
+    step_j = jax.jit(step_acc, donate_argnums=(0, 1))
+
+    # warmup/compile
+    state, acc = step_j(state, jnp.int32(0), jnp.int32(1))
+    jax.block_until_ready(acc)
+    acc = jnp.int32(0)
+
+    t0 = time.perf_counter()
+    for i in range(n_ticks):
+        state, acc = step_j(state, acc, jnp.int32(1 + (i + 1) * G))
+    total_decisions = int(acc)  # blocks until all ticks complete
+    dt = time.perf_counter() - t0
+
+    dps = total_decisions / dt
+    print(
+        json.dumps(
+            {
+                "metric": f"decisions_per_sec_per_chip_{G}_groups_{R}_replicas",
+                "value": round(dps, 1),
+                "unit": "decisions/s",
+                "vs_baseline": round(dps / BASELINE_DECISIONS_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

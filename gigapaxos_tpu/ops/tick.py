@@ -26,6 +26,10 @@ same reductions into ICI collectives (psum/all-gather) — the TPU-native
 equivalent of the reference's NIO ACCEPT fan-out / ACCEPT_REPLY fan-in
 (``nio/NIOTransport.java:65-114``).
 
+Layout: all ring windows are ``[R, W, G]`` (G = lane axis; see state.py), and
+ring gathers are one-hot selects over the W planes (``window.gather_planes``)
+so the lane axis never participates in a hardware gather.
+
 Failure model: ``inbox.alive`` is the host failure detector's liveness view
 (``FailureDetection.isNodeUp``, FailureDetection.java:252-258).  A dead
 replica contributes nothing and its state freezes; flipping it back alive
@@ -36,7 +40,6 @@ replay recovery (see ``wal/logger.py``).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -44,6 +47,7 @@ import jax.numpy as jnp
 
 from ..types import GroupStatus, NO_REQUEST
 from .ballot import bal_ge, bal_gt
+from .window import gather_planes
 
 I32 = jnp.int32
 NEG_INF = jnp.int32(-(2**31))
@@ -52,9 +56,9 @@ NEG_INF = jnp.int32(-(2**31))
 class TickInbox(NamedTuple):
     """Per-tick inputs assembled by the host batcher.
 
-    req:   int32 [R, G, P] — new client request ids that arrived at entry
+    req:   int32 [R, P, G] — new client request ids that arrived at entry
            replica r for group g this tick (0 = empty slot).
-    stop:  bool  [R, G, P] — request is a paxos stop (end-of-epoch).
+    stop:  bool  [R, P, G] — request is a paxos stop (end-of-epoch).
     alive: bool  [R]       — failure-detector liveness per replica slot.
     """
 
@@ -66,12 +70,12 @@ class TickInbox(NamedTuple):
 class TickOutbox(NamedTuple):
     """Per-tick outputs consumed by the host (app execution, callbacks, WAL).
 
-    exec_req:   int32 [R, G, W] — request ids executed this tick, position j
+    exec_req:   int32 [R, W, G] — request ids executed this tick, plane j
                 holds slot exec_base+j (0 = noop/empty).
-    exec_stop:  bool  [R, G, W]
+    exec_stop:  bool  [R, W, G]
     exec_base:  int32 [R, G]    — first slot executed this tick.
     exec_count: int32 [R, G]    — number of slots executed this tick.
-    intake_taken: bool [R, G, P] — which inbox requests got slots (host
+    intake_taken: bool [R, P, G] — which inbox requests got slots (host
                 re-enqueues the rest, mirroring RequestBatcher backpressure).
     coord_id:   int32 [G]       — current effective coordinator (-1 if none).
     decided_now: int32 [G]      — decisions reaching quorum this tick (metric).
@@ -99,25 +103,18 @@ def _lexmax(n, c, axis):
     return jnp.squeeze(nmax, axis=axis), cmax
 
 
-def _alive_at(alive_ext, ids):
-    """alive lookup for node-id arrays; id -1 (nobody) reads the appended
-    False slot."""
-    R = alive_ext.shape[0] - 1
-    return alive_ext[jnp.clip(ids, -1, R - 1)]
-
-
-@partial(jax.jit, donate_argnums=(0,))
-def paxos_tick(state, inbox: TickInbox):
+def paxos_tick_impl(state, inbox: TickInbox):
+    """Un-jitted tick body (jit/shard it yourself; `paxos_tick` below is the
+    ready-made single-program jit with state donation)."""
     R, G = state.exec_slot.shape
-    W = state.acc_req.shape[2]
-    P = inbox.req.shape[2]
+    W = state.acc_req.shape[1]
+    P = inbox.req.shape[1]
     RP = R * P
     Wm = jnp.int32(W - 1)
 
     alive = inbox.alive
-    alive_ext = jnp.concatenate([alive, jnp.zeros((1,), jnp.bool_)])  # id -1
     r_idx = jnp.arange(R, dtype=I32)[:, None]  # [R, 1] broadcasts over G
-    member = state.member.T  # [R, G]
+    member = state.member  # [R, G] bool
     is_active = state.status == int(GroupStatus.ACTIVE)  # [R, G]
     acc_ok = member & alive[:, None] & is_active  # live active member [R, G]
     # serve_ok: may serve decisions from its ring even after STOPPED, so a
@@ -125,6 +122,13 @@ def paxos_tick(state, inbox: TickInbox):
     # group wedges with one eternally-ACTIVE stuck replica).
     serve_ok = member & alive[:, None] & (state.status != int(GroupStatus.FREE))
     maj = state.n_members // 2 + 1  # [G]
+
+    def alive_at(ids):
+        """Liveness lookup by global node id ([..] int32; -1 -> False)."""
+        out = jnp.zeros(ids.shape, jnp.bool_)
+        for r in range(R):
+            out = jnp.where(ids == r, alive[r], out)
+        return out
 
     # Common window base: max exec slot among live members (all caught-up live
     # replicas share it; laggards resync in phase 3).
@@ -138,11 +142,12 @@ def paxos_tick(state, inbox: TickInbox):
         jnp.max(jnp.where(serve_ok, state.exec_slot, NEG_INF), axis=0),
         0,
     ).astype(I32)
-    s_j = base[:, None] + jnp.arange(W, dtype=I32)[None, :]  # [G, W] abs slots
-    i_j = jnp.bitwise_and(s_j, Wm)  # [G, W] ring indices (replica-agnostic)
+    jw = jnp.arange(W, dtype=I32)[:, None]  # [W, 1]
+    s_j = base[None, :] + jw  # [W, G] absolute slots, window order
+    i_j = jnp.bitwise_and(s_j, Wm)  # [W, G] ring indices (replica-agnostic)
 
     # ---------------- phase 0: candidacy ----------------
-    coord_dead = ~_alive_at(alive_ext, state.bal_coord)  # [R, G]
+    coord_dead = ~alive_at(state.bal_coord)  # [R, G]
     caught_up = (state.exec_slot - base[None, :]) >= 0
     # candidate = first live *caught-up* member: a stuck laggard must not
     # hold the coordinatorship hostage (at least one live member is always
@@ -153,7 +158,7 @@ def paxos_tick(state, inbox: TickInbox):
     have_auth = (state.coord_active | state.coord_preparing) & bal_ge(
         state.coord_bnum, r_idx, state.bal_num, state.bal_coord
     )
-    start_prep = im_cand & coord_dead & caught_up & ~have_auth
+    start_prep = im_cand & coord_dead & ~have_auth
     coord_bnum = jnp.where(
         start_prep,
         jnp.maximum(state.bal_num, state.coord_bnum) + 1,
@@ -163,7 +168,7 @@ def paxos_tick(state, inbox: TickInbox):
     coord_active = state.coord_active
 
     # ---------------- phase 1: prepare / promise / carryover ----------------
-    prep_mask = coord_preparing & acc_ok  # [R, G] broadcasting candidates
+    prep_mask = coord_preparing & acc_ok  # [R, G] candidates broadcasting
     pn = jnp.where(prep_mask, coord_bnum, NEG_INF)
     best_pn, best_pc = _lexmax(pn, jnp.broadcast_to(r_idx, (R, G)), axis=0)  # [G]
     upgrade = (
@@ -184,33 +189,31 @@ def paxos_tick(state, inbox: TickInbox):
     promises = jnp.sum(match, axis=1).astype(I32)  # [R, G]
     won = prep_mask & (promises >= maj[None, :])  # at most one winner per g
 
-    # Gather every replica's accepted window at the common base ring indices.
-    idx = i_j[None, :, :]  # [1, G, W] -> broadcasts over R in take_along_axis
-    idxR = jnp.broadcast_to(idx, (R, G, W))
-    a_bnum = jnp.take_along_axis(state.acc_bnum, idxR, axis=2)
-    a_bcoord = jnp.take_along_axis(state.acc_bcoord, idxR, axis=2)
-    a_req = jnp.take_along_axis(state.acc_req, idxR, axis=2)
-    a_slot = jnp.take_along_axis(state.acc_slot, idxR, axis=2)
-    a_stop = jnp.take_along_axis(state.acc_stop, idxR, axis=2)
-    acc_here = (a_slot == s_j[None, :, :]) & (a_bnum >= 0)  # [R, G, W]
+    # Gather every replica's accepted window at the common base ring indices:
+    # A_x[r, j, g] = acc_x[r, i_j[j, g], g].
+    a_bnum = gather_planes(state.acc_bnum, i_j)
+    a_bcoord = gather_planes(state.acc_bcoord, i_j)
+    a_req = gather_planes(state.acc_req, i_j)
+    a_slot = gather_planes(state.acc_slot, i_j)
+    a_stop = gather_planes(state.acc_stop, i_j)
+    acc_here = (a_slot == s_j[None, :, :]) & (a_bnum >= 0)  # [R, W, G]
 
     # carryover: among the winner's promisers, max-ballot accepted pvalue/slot
     promiser = jnp.einsum("rg,rsg->sg", won, match).astype(jnp.bool_)  # [R, G]
-    eff = promiser[:, :, None] & acc_here
-    c_n, c_c = _lexmax(jnp.where(eff, a_bnum, NEG_INF), a_bcoord, axis=0)  # [G, W]
+    eff = promiser[:, None, :] & acc_here
+    c_n, c_c = _lexmax(jnp.where(eff, a_bnum, NEG_INF), a_bcoord, axis=0)  # [W, G]
     c_exists = jnp.any(eff, axis=0)
     sel = eff & (a_bnum == c_n[None]) & (a_bcoord == c_c[None])
     c_req = jnp.max(jnp.where(sel, a_req, 0), axis=0)
     c_stop = jnp.any(sel & a_stop, axis=0)
     # noop-fill gaps below the highest carried slot so later slots can commit
-    jar = jnp.arange(W, dtype=I32)[None, :]
-    hi = jnp.max(jnp.where(c_exists, jar, -1), axis=1)  # [G], -1 if none
-    c_valid = jar <= hi[:, None]  # [G, W] window order
-    # window-order -> ring-order: ring position i holds window offset (i-base)%W
-    j_of_i = jnp.bitwise_and(jar - base[:, None], Wm)  # [G, W]
+    hi = jnp.max(jnp.where(c_exists, jw, -1), axis=0)  # [G], -1 if none
+    c_valid = jw <= hi[None, :]  # [W, G] window order
+    # window-order -> ring-order: ring plane i holds window offset (i-base)%W
+    j_of_i = jnp.bitwise_and(jw - base[None, :], Wm)  # [W, G]
 
-    def to_ring(v):  # [G, W] window-order -> ring-order
-        return jnp.take_along_axis(v, j_of_i, axis=1)
+    def to_ring(v):  # [W, G] window-order -> ring-order
+        return gather_planes(v, j_of_i)
 
     co_req, co_stop, co_valid, co_slot = (
         to_ring(c_req),
@@ -218,7 +221,7 @@ def paxos_tick(state, inbox: TickInbox):
         to_ring(c_valid),
         to_ring(s_j),
     )
-    won3 = won[:, :, None]
+    won3 = won[:, None, :]
     prop_req = jnp.where(won3, co_req[None], state.prop_req)
     prop_slot = jnp.where(won3, co_slot[None], state.prop_slot)
     prop_valid = jnp.where(won3, co_valid[None], state.prop_valid)
@@ -235,7 +238,7 @@ def paxos_tick(state, inbox: TickInbox):
     retire = bal_gt(pm_n[None, :], pm_c[None, :], coord_bnum, r_idx)
     coord_active = coord_active & ~retire
     coord_preparing = coord_preparing & ~retire
-    prop_valid = prop_valid & ~retire[:, :, None]
+    prop_valid = prop_valid & ~retire[:, None, :]
 
     # ---------------- phase 2a: intake + slot assignment ----------------
     an = jnp.where(coord_active & acc_ok, coord_bnum, NEG_INF)
@@ -243,67 +246,69 @@ def paxos_tick(state, inbox: TickInbox):
     has_coord = w_n != NEG_INF
     is_win = (r_idx == w_c[None, :]) & has_coord[None, :]  # [R, G]
 
-    req_flat = jnp.transpose(inbox.req, (1, 0, 2)).reshape(G, RP)
-    stop_flat = jnp.transpose(inbox.stop, (1, 0, 2)).reshape(G, RP)
-    src_alive = jnp.broadcast_to(alive[None, :, None], (G, R, P)).reshape(G, RP)
+    req_flat = inbox.req.reshape(RP, G)
+    stop_flat = inbox.stop.reshape(RP, G)
+    src_alive = jnp.broadcast_to(
+        alive[:, None, None], (R, P, G)
+    ).reshape(RP, G)
     group_open = has_coord & jnp.any(is_win & is_active, axis=0)
-    valid_in = (req_flat != NO_REQUEST) & src_alive & group_open[:, None]
-    order = jnp.argsort(~valid_in, axis=1, stable=True)  # valid first, FIFO
-    req_sorted = jnp.take_along_axis(req_flat, order, axis=1)
-    stop_sorted = jnp.take_along_axis(stop_flat, order, axis=1)
-    k_total = jnp.sum(valid_in, axis=1).astype(I32)  # [G]
+    valid_in = (req_flat != NO_REQUEST) & src_alive & group_open[None, :]
+    order = jnp.argsort(~valid_in, axis=0, stable=True)  # valid first, FIFO
+    req_sorted = jnp.take_along_axis(req_flat, order, axis=0)
+    stop_sorted = jnp.take_along_axis(stop_flat, order, axis=0)
+    k_total = jnp.sum(valid_in, axis=0).astype(I32)  # [G]
     w_next = jnp.sum(jnp.where(is_win, next_slot, 0), axis=0).astype(I32)  # [G]
     w_exec = jnp.sum(jnp.where(is_win, state.exec_slot, 0), axis=0).astype(I32)
     space = jnp.maximum(jnp.int32(W) - (w_next - w_exec), 0)
     k = jnp.minimum(k_total, space)  # [G]
     # stop-request fencing: nothing may be proposed after a stop; if a stop is
     # among the first k, truncate intake right after it.
-    taken_pre = jnp.arange(RP, dtype=I32)[None, :] < k[:, None]
-    stop_before = jnp.cumsum((stop_sorted & taken_pre).astype(I32), axis=1) - (
-        stop_sorted & taken_pre
-    )
+    jrp = jnp.arange(RP, dtype=I32)[:, None]  # [RP, 1]
+    taken_pre = jrp < k[None, :]
+    stop_taken = stop_sorted & taken_pre
+    stop_before = jnp.cumsum(stop_taken.astype(I32), axis=0) - stop_taken.astype(I32)
     taken_sorted = taken_pre & (stop_before == 0)
-    k = jnp.sum(taken_sorted, axis=1).astype(I32)
+    k = jnp.sum(taken_sorted, axis=0).astype(I32)
 
     pad = max(0, W - RP)
-    req_pad = jnp.pad(req_sorted, ((0, 0), (0, pad)))
-    stop_pad = jnp.pad(stop_sorted, ((0, 0), (0, pad)))
-    ji = jnp.bitwise_and(jnp.arange(W, dtype=I32)[None, :] - w_next[:, None], Wm)
-    new_at_i = ji < k[:, None]  # [G, W] ring positions receiving new proposals
-    nreq_i = jnp.take_along_axis(req_pad, jnp.minimum(ji, RP + pad - 1), axis=1)
-    nstop_i = jnp.take_along_axis(stop_pad, jnp.minimum(ji, RP + pad - 1), axis=1)
-    nslot_i = w_next[:, None] + ji
-    wmask = is_win[:, :, None] & new_at_i[None, :, :]
+    req_pad = jnp.pad(req_sorted, ((0, pad), (0, 0)))
+    stop_pad = jnp.pad(stop_sorted, ((0, pad), (0, 0)))
+    ji = jnp.bitwise_and(jw - w_next[None, :], Wm)  # [W, G]
+    new_at_i = ji < k[None, :]  # [W, G] ring planes receiving new proposals
+    nreq_i = gather_planes(req_pad, jnp.minimum(ji, RP + pad - 1))
+    nstop_i = gather_planes(stop_pad, jnp.minimum(ji, RP + pad - 1))
+    nslot_i = w_next[None, :] + ji
+    wmask = is_win[:, None, :] & new_at_i[None, :, :]
     prop_req = jnp.where(wmask, nreq_i[None], prop_req)
     prop_stop = jnp.where(wmask, nstop_i[None], prop_stop)
     prop_slot = jnp.where(wmask, nslot_i[None], prop_slot)
     prop_valid = prop_valid | wmask
     next_slot = jnp.where(is_win, w_next[None, :] + k[None, :], next_slot)
 
-    rank = jnp.argsort(order, axis=1, stable=True)  # inverse permutation
-    taken_flat = jnp.take_along_axis(taken_sorted, rank, axis=1)
-    intake_taken = jnp.transpose(taken_flat.reshape(G, R, P), (1, 0, 2))
+    rank = jnp.argsort(order, axis=0, stable=True)  # inverse permutation
+    taken_flat = jnp.take_along_axis(taken_sorted, rank, axis=0)
+    intake_taken = taken_flat.reshape(R, P, G)
 
     # ---------------- phase 2b: accept ----------------
-    pushing = (coord_active & acc_ok)[:, :, None] & prop_valid  # [R, G, W]
-    cand_n = jnp.where(pushing, coord_bnum[:, :, None], NEG_INF)
-    cand_c = jnp.broadcast_to(r_idx[:, :, None], (R, G, W))
-    b_n, b_c = _lexmax(cand_n, cand_c, axis=0)  # [G, W] best pushed ballot
+    pushing = (coord_active & acc_ok)[:, None, :] & prop_valid  # [R, W, G]
+    cand_n = jnp.where(pushing, coord_bnum[:, None, :], NEG_INF)
+    cand_c = jnp.broadcast_to(r_idx[:, None, :], (R, W, G))
+    b_n, b_c = _lexmax(cand_n, cand_c, axis=0)  # [W, G] best pushed ballot
     psel = pushing & (cand_n == b_n[None]) & (cand_c == b_c[None])
-    p_req = jnp.max(jnp.where(psel, prop_req, 0), axis=0)  # [G, W]
+    p_req = jnp.max(jnp.where(psel, prop_req, 0), axis=0)  # [W, G]
     p_slot = jnp.max(jnp.where(psel, prop_slot, NEG_INF), axis=0)
     p_stop = jnp.any(psel & prop_stop, axis=0)
     exists = b_n != NEG_INF
 
-    d = p_slot[None, :, :] - state.exec_slot[:, :, None]  # [R, G, W]
+    d = p_slot[None, :, :] - state.exec_slot[:, None, :]  # [R, W, G]
     in_win = (d >= 0) & (d < W)
     acceptable = (
         exists[None]
         & in_win
-        & bal_ge(b_n[None], b_c[None], bal_num[:, :, None], bal_coord[:, :, None])
-        & acc_ok[:, :, None]
+        & bal_ge(b_n[None], b_c[None], bal_num[:, None, :], bal_coord[:, None, :])
+        & acc_ok[:, None, :]
     )
-    # ring slot for pvalue at slot p_slot is its own index position already
+    # ring plane for pvalue at slot p_slot is its own plane position already
     # (coordinators store proposals ring-indexed by slot), so accept in place.
     acc_bnum = jnp.where(acceptable, b_n[None], state.acc_bnum)
     acc_bcoord = jnp.where(acceptable, b_c[None], state.acc_bcoord)
@@ -314,36 +319,29 @@ def paxos_tick(state, inbox: TickInbox):
     ab_n, ab_c = _lexmax(
         jnp.where(acceptable, b_n[None], NEG_INF),
         jnp.where(acceptable, b_c[None], NEG_INF),
-        axis=2,
+        axis=1,
     )  # [R, G]
     raise_p = (ab_n != NEG_INF) & bal_gt(ab_n, ab_c, bal_num, bal_coord)
     bal_num = jnp.where(raise_p, ab_n, bal_num)
     bal_coord = jnp.where(raise_p, ab_c, bal_coord)
 
     # ---------------- phase 2c: tally + quorum ----------------
-    A_bnum = jnp.take_along_axis(acc_bnum, idxR, axis=2)
-    A_bcoord = jnp.take_along_axis(acc_bcoord, idxR, axis=2)
-    A_req = jnp.take_along_axis(acc_req, idxR, axis=2)
-    A_slot = jnp.take_along_axis(acc_slot, idxR, axis=2)
-    A_stop = jnp.take_along_axis(acc_stop, idxR, axis=2)
-    voteable = (A_slot == s_j[None]) & (A_bnum >= 0) & acc_ok[:, :, None]
+    A_bnum = gather_planes(acc_bnum, i_j)
+    A_bcoord = gather_planes(acc_bcoord, i_j)
+    A_req = gather_planes(acc_req, i_j)
+    A_slot = gather_planes(acc_slot, i_j)
+    A_stop = gather_planes(acc_stop, i_j)
+    voteable = (A_slot == s_j[None]) & (A_bnum >= 0) & acc_ok[:, None, :]
     B_n, B_c = _lexmax(jnp.where(voteable, A_bnum, NEG_INF), A_bcoord, axis=0)
     votes = voteable & (A_bnum == B_n[None]) & (A_bcoord == B_c[None])
-    cnt = jnp.sum(votes, axis=0).astype(I32)  # [G, W]
-    decided = (cnt >= maj[:, None]) & (B_n != NEG_INF)  # [G, W] window order
+    cnt = jnp.sum(votes, axis=0).astype(I32)  # [W, G]
+    decided = (cnt >= maj[None, :]) & (B_n != NEG_INF)  # [W, G] window order
     v_req = jnp.max(jnp.where(votes, A_req, 0), axis=0)
     v_stop = jnp.any(votes & A_stop, axis=0)
-    decided_now = jnp.sum(
-        decided
-        & ~(
-            jnp.any(
-                (jnp.take_along_axis(state.dec_slot, idxR, axis=2) == s_j[None])
-                & jnp.take_along_axis(state.dec_valid, idxR, axis=2),
-                axis=0,
-            )
-        ),
-        axis=1,
-    ).astype(I32)
+    D_slot = gather_planes(state.dec_slot, i_j)
+    D_valid = gather_planes(state.dec_valid, i_j)
+    already = jnp.any((D_slot == s_j[None]) & D_valid, axis=0)  # [W, G]
+    decided_now = jnp.sum(decided & ~already, axis=0).astype(I32)  # [G]
 
     de_req, de_stop, de_valid, de_slot = (
         to_ring(v_req),
@@ -352,34 +350,32 @@ def paxos_tick(state, inbox: TickInbox):
         to_ring(s_j),
     )
     # write decisions, but never clobber a laggard's still-undelivered ring
-    fwd = (de_slot[None] - state.exec_slot[:, :, None] >= 0) & (
-        de_slot[None] - state.exec_slot[:, :, None] < W
-    )
-    dwrite = de_valid[None] & fwd & acc_ok[:, :, None]
+    rel_w = de_slot[None] - state.exec_slot[:, None, :]
+    dwrite = de_valid[None] & (rel_w >= 0) & (rel_w < W) & acc_ok[:, None, :]
     dec_req = jnp.where(dwrite, de_req[None], state.dec_req)
     dec_slot = jnp.where(dwrite, de_slot[None], state.dec_slot)
     dec_stop = jnp.where(dwrite, de_stop[None], state.dec_stop)
     dec_valid = jnp.where(dwrite, True, state.dec_valid)
 
     # ---------------- phase 3: decision sync (laggard catch-up) ----------------
-    # latest decision per ring index among live members, then each replica
-    # adopts entries that fall inside its own forward window.
+    # latest decision per ring plane among live serving members, then each
+    # replica adopts entries that fall inside its own forward window.
     rel = jnp.where(
-        dec_valid & serve_ok[:, :, None], dec_slot - base[None, :, None], NEG_INF
-    )  # [R, G, W] relative slots are small; max = latest
-    rel_best = jnp.max(rel, axis=0)  # [G, W]
+        dec_valid & serve_ok[:, None, :], dec_slot - base[None, None, :], NEG_INF
+    )  # [R, W, G] relative slots are small; max = latest
+    rel_best = jnp.max(rel, axis=0)  # [W, G]
     sel_l = rel == rel_best[None]
     l_req = jnp.max(jnp.where(sel_l, dec_req, 0), axis=0)
     l_stop = jnp.any(sel_l & dec_stop, axis=0)
-    l_slot = rel_best + base[:, None]  # [G, W] absolute
+    l_slot = rel_best + base[None, :]  # [W, G] absolute
     have = dec_valid & (dec_slot == l_slot[None])
-    d2 = l_slot[None] - state.exec_slot[:, :, None]
+    d2 = l_slot[None] - state.exec_slot[:, None, :]
     adopt = (
         (rel_best[None] != NEG_INF)
         & (d2 >= 0)
         & (d2 < W)
         & ~have
-        & acc_ok[:, :, None]
+        & acc_ok[:, None, :]
     )
     dec_req = jnp.where(adopt, l_req[None], dec_req)
     dec_slot = jnp.where(adopt, l_slot[None], dec_slot)
@@ -387,27 +383,27 @@ def paxos_tick(state, inbox: TickInbox):
     dec_valid = jnp.where(adopt, True, dec_valid)
 
     # ---------------- phase 4: in-order execution ----------------
-    s_own = state.exec_slot[:, :, None] + jnp.arange(W, dtype=I32)[None, None, :]
+    s_own = state.exec_slot[:, None, :] + jw[None]  # [R, W, G]
     i_own = jnp.bitwise_and(s_own, Wm)
-    Dreq = jnp.take_along_axis(dec_req, i_own, axis=2)
-    Dslot = jnp.take_along_axis(dec_slot, i_own, axis=2)
-    Dstop = jnp.take_along_axis(dec_stop, i_own, axis=2)
-    Dval = jnp.take_along_axis(dec_valid, i_own, axis=2)
-    ready = Dval & (Dslot == s_own) & acc_ok[:, :, None]
-    run = jnp.cumprod(ready.astype(I32), axis=2).astype(jnp.bool_)
+    Dreq = gather_planes(dec_req, i_own)
+    Dslot = gather_planes(dec_slot, i_own)
+    Dstop = gather_planes(dec_stop, i_own)
+    Dval = gather_planes(dec_valid, i_own)
+    ready = Dval & (Dslot == s_own) & acc_ok[:, None, :]
+    run = jnp.cumprod(ready.astype(I32), axis=1).astype(jnp.bool_)
     stop_hit = run & Dstop
-    stop_before = jnp.cumsum(stop_hit.astype(I32), axis=2) - stop_hit.astype(I32)
-    exec_mask = run & (stop_before == 0)
-    n_exec = jnp.sum(exec_mask, axis=2).astype(I32)  # [R, G]
+    stop_before2 = jnp.cumsum(stop_hit.astype(I32), axis=1) - stop_hit.astype(I32)
+    exec_mask = run & (stop_before2 == 0)
+    n_exec = jnp.sum(exec_mask, axis=1).astype(I32)  # [R, G]
     exec_req_out = jnp.where(exec_mask, Dreq, NO_REQUEST)
     exec_stop_out = exec_mask & Dstop
     exec_base = state.exec_slot
     exec_slot = state.exec_slot + n_exec
-    stopped_now = jnp.any(exec_mask & Dstop, axis=2)
+    stopped_now = jnp.any(exec_mask & Dstop, axis=1)
     status = jnp.where(stopped_now, jnp.int32(int(GroupStatus.STOPPED)), state.status)
 
     # coordinator GC: stop pushing proposals already executed locally
-    prop_valid = prop_valid & (prop_slot - exec_slot[:, :, None] >= 0)
+    prop_valid = prop_valid & (prop_slot - exec_slot[:, None, :] >= 0)
 
     # ---------------- freeze dead replica slots ----------------
     al3 = alive[:, None, None]
@@ -459,10 +455,13 @@ def paxos_tick(state, inbox: TickInbox):
     return new_state, outbox
 
 
+paxos_tick = jax.jit(paxos_tick_impl, donate_argnums=(0,))
+
+
 def make_inbox(n_replicas: int, n_groups: int, per_tick: int) -> TickInbox:
     """An empty inbox template (host fills rows it has traffic for)."""
     return TickInbox(
-        req=jnp.zeros((n_replicas, n_groups, per_tick), I32),
-        stop=jnp.zeros((n_replicas, n_groups, per_tick), jnp.bool_),
+        req=jnp.zeros((n_replicas, per_tick, n_groups), I32),
+        stop=jnp.zeros((n_replicas, per_tick, n_groups), jnp.bool_),
         alive=jnp.ones((n_replicas,), jnp.bool_),
     )

@@ -131,7 +131,7 @@ class PaxosManager:
         row = self.rows.row(name)
         if row is None:
             return None
-        return [int(r) for r in np.where(np.array(self.state.member[row]))[0]]
+        return [int(r) for r in np.where(np.array(self.state.member[:, row]))[0]]
 
     def is_stopped(self, name: str) -> bool:
         row = self.rows.row(name)
@@ -161,7 +161,7 @@ class PaxosManager:
             return None
         rid = self._next_rid
         self._next_rid += 1
-        members = np.where(np.array(self.state.member[row]))[0]
+        members = np.where(np.array(self.state.member[:, row]))[0]
         if entry is None or entry not in members:
             # spread entry replicas across the group's members (not the whole
             # replica set — a non-member never executes, so its callback
@@ -190,8 +190,8 @@ class PaxosManager:
 
     # ------------------------------------------------------------------- tick
     def _build_inbox(self) -> TickInbox:
-        req = np.zeros((self.R, self.G, self.P), np.int32)
-        stp = np.zeros((self.R, self.G, self.P), bool)
+        req = np.zeros((self.R, self.P, self.G), np.int32)
+        stp = np.zeros((self.R, self.P, self.G), bool)
         placed = []
         for row, q in self._queues.items():
             used = collections.Counter()
@@ -204,7 +204,7 @@ class PaxosManager:
                 if not self.alive[rec.entry]:
                     # re-home the request to a live *member* so the response
                     # callback is not orphaned on a dead entry node
-                    ms = np.where(np.array(self.state.member[row]))[0]
+                    ms = np.where(np.array(self.state.member[:, row]))[0]
                     live = [m for m in ms if self.alive[m]]
                     if not live:
                         q.appendleft(rid)
@@ -216,8 +216,8 @@ class PaxosManager:
                     q.appendleft(rid)
                     break
                 used[entry] += 1
-                req[entry, row, p] = rid
-                stp[entry, row, p] = rec.stop
+                req[entry, p, row] = rid
+                stp[entry, p, row] = rec.stop
                 take.append((rid, entry, p))
             placed.append((row, take))
         self._placed = placed
@@ -255,13 +255,13 @@ class PaxosManager:
         taken = np.array(out.intake_taken)
         for row, take in self._placed:
             for rid, entry, p in reversed(take):
-                if not taken[entry, row, p] and rid in self.outstanding:
+                if not taken[entry, p, row] and rid in self.outstanding:
                     self._queues[row].appendleft(rid)  # retry next tick
         er = np.array(out.exec_req)
         es = np.array(out.exec_stop)
         eb = np.array(out.exec_base)
         ec = np.array(out.exec_count)
-        active = np.where(np.array(out.exec_count).sum(axis=0) > 0)[0] if ec.any() else []
+        active = np.where(ec.sum(axis=0) > 0)[0] if ec.any() else []
         for row in active:
             name = self.rows.name(int(row))
             if name is None:
@@ -269,9 +269,9 @@ class PaxosManager:
             for r in range(self.R):
                 n = int(ec[r, row])
                 for j in range(n):
-                    rid = int(er[r, row, j])
+                    rid = int(er[r, j, row])
                     slot = int(eb[r, row]) + j
-                    is_stop = bool(es[r, row, j])
+                    is_stop = bool(es[r, j, row])
                     self._execute_one(r, int(row), name, rid, slot, is_stop)
         self.stats["decisions"] += int(np.array(out.decided_now).sum())
 
@@ -318,7 +318,7 @@ class PaxosManager:
         for rid, rec in self.outstanding.items():
             if not rec.responded or rec.slot < 0:
                 continue
-            ms = np.where(member[rec.row])[0]
+            ms = np.where(member[:, rec.row])[0]
             live = [m for m in ms if self.alive[m]]
             if live and all(exec_slot[m, rec.row] > rec.slot for m in live):
                 dead.append(rid)
@@ -340,7 +340,7 @@ class PaxosManager:
         if row is None:
             return False
         exec_slot = np.array(self.state.exec_slot[:, row])
-        members = np.where(np.array(self.state.member[row]))[0]
+        members = np.where(np.array(self.state.member[:, row]))[0]
         donors = [m for m in members if self.alive[m] and m != r]
         if not donors:
             return False

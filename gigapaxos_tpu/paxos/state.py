@@ -6,18 +6,24 @@ TPU re-expression of the reference's per-group objects:
 * acceptor scalars (``PaxosAcceptor.java:94-101``: ``_slot``, ``ballotNum``,
   ``ballotCoord``, ``acceptedGCSlot``, ``state``) -> ``int32`` arrays ``[R, G]``;
 * the sparse ``acceptedProposals`` / ``committedRequests`` maps
-  (``PaxosAcceptor.java:108-115``) -> ring windows ``[R, G, W]`` addressed by
-  ``slot & (W-1)``;
+  (``PaxosAcceptor.java:108-115``) -> ring windows ``[R, W, G]`` addressed by
+  ``slot & (W-1)`` on the W axis;
 * coordinator state (``PaxosCoordinatorState.java:69-144``: ballot, myProposals,
   nextProposalSlot, waitfors) -> ``[R, G]`` scalars plus a proposal ring
-  ``[R, G, W]``; the WaitforUtility majority tally
+  ``[R, W, G]``; the WaitforUtility majority tally
   (``paxosutil/WaitforUtility.java:34-68``) has no stored analog — it is
   recomputed each tick as a popcount over the replica axis;
-* group membership -> a replicated bool mask ``[G, R]`` plus member count.
+* group membership -> a bool mask ``[R, G]`` plus member count ``[G]``.
 
-Request payloads never enter the device: requests are ``int32`` ids handed out
-by the host (see ``paxos/manager.py``); the device orders ids, the host owns
-bytes.  ``NO_REQUEST`` (0) marks empty slots and no-op decisions.
+Layout note (TPU-critical): the group axis G is always the **minor (lane)
+dimension** and the ring depth W sits in the sublane axis.  With the naive
+``[R, G, W]`` layout the W=8 lane dimension pads to 128 on TPU — a 16x HBM
+blowup that caps throughput; ``[R, W, G]`` tiles perfectly (measured ~2
+orders of magnitude faster at 1M groups).
+
+Request payloads never enter the device: requests are ``int32`` ids handed
+out by the host (see ``paxos/manager.py``); the device orders ids, the host
+owns bytes.  ``NO_REQUEST`` (0) marks empty slots and no-op decisions.
 
 The replica axis doubles as the mesh axis ``replica`` when sharded (see
 ``parallel/mesh.py``): reductions over axis 0 become ICI collectives under
@@ -49,14 +55,14 @@ class PaxosState(NamedTuple):
     bal_coord: jnp.ndarray  # promised ballot coordinator
     status: jnp.ndarray  # GroupStatus per replica
 
-    # ---- accepted-pvalue ring [R, G, W] ----
+    # ---- accepted-pvalue ring [R, W, G] ----
     acc_bnum: jnp.ndarray
     acc_bcoord: jnp.ndarray
     acc_req: jnp.ndarray
     acc_slot: jnp.ndarray  # absolute slot the entry holds (validity check)
     acc_stop: jnp.ndarray  # bool: pvalue is a stop request
 
-    # ---- decision ring [R, G, W] (last W learned decisions) ----
+    # ---- decision ring [R, W, G] (last W learned decisions) ----
     dec_req: jnp.ndarray
     dec_slot: jnp.ndarray
     dec_valid: jnp.ndarray
@@ -68,14 +74,14 @@ class PaxosState(NamedTuple):
     coord_bnum: jnp.ndarray  # my ballot number (coordinator id == replica idx)
     next_slot: jnp.ndarray  # next slot I will assign
 
-    # ---- coordinator proposal ring [R, G, W] (my in-flight phase-2 pvalues) ----
+    # ---- coordinator proposal ring [R, W, G] (my in-flight phase-2 pvalues) ----
     prop_req: jnp.ndarray
     prop_slot: jnp.ndarray
     prop_valid: jnp.ndarray
     prop_stop: jnp.ndarray
 
-    # ---- group config, replicated [G, R] / [G] ----
-    member: jnp.ndarray  # bool [G, R]
+    # ---- group config [R, G] / [G] ----
+    member: jnp.ndarray  # bool [R, G]: replica slot r is a member of group g
     n_members: jnp.ndarray  # int32 [G]
     epoch: jnp.ndarray  # int32 [G]
 
@@ -89,7 +95,7 @@ class PaxosState(NamedTuple):
 
     @property
     def window(self) -> int:
-        return self.acc_req.shape[2]
+        return self.acc_req.shape[1]
 
 
 def init_state(n_replicas: int, n_groups: int, window: int) -> PaxosState:
@@ -104,32 +110,32 @@ def init_state(n_replicas: int, n_groups: int, window: int) -> PaxosState:
     def f_rg():
         return jnp.zeros((R, G), BOOL)
 
-    def f_rgw():
-        return jnp.zeros((R, G, W), BOOL)
+    def f_rwg():
+        return jnp.zeros((R, W, G), BOOL)
 
     return PaxosState(
         exec_slot=z_rg(),
         bal_num=jnp.full((R, G), INITIAL_BALLOT_NUM, I32),
         bal_coord=jnp.full((R, G), INITIAL_BALLOT_COORD, I32),
         status=jnp.full((R, G), int(GroupStatus.FREE), I32),
-        acc_bnum=jnp.full((R, G, W), INITIAL_BALLOT_NUM, I32),
-        acc_bcoord=jnp.full((R, G, W), INITIAL_BALLOT_COORD, I32),
-        acc_req=jnp.full((R, G, W), NO_REQUEST, I32),
-        acc_slot=jnp.full((R, G, W), -1, I32),
-        acc_stop=f_rgw(),
-        dec_req=jnp.full((R, G, W), NO_REQUEST, I32),
-        dec_slot=jnp.full((R, G, W), -1, I32),
-        dec_valid=f_rgw(),
-        dec_stop=f_rgw(),
+        acc_bnum=jnp.full((R, W, G), INITIAL_BALLOT_NUM, I32),
+        acc_bcoord=jnp.full((R, W, G), INITIAL_BALLOT_COORD, I32),
+        acc_req=jnp.full((R, W, G), NO_REQUEST, I32),
+        acc_slot=jnp.full((R, W, G), -1, I32),
+        acc_stop=f_rwg(),
+        dec_req=jnp.full((R, W, G), NO_REQUEST, I32),
+        dec_slot=jnp.full((R, W, G), -1, I32),
+        dec_valid=f_rwg(),
+        dec_stop=f_rwg(),
         coord_active=f_rg(),
         coord_preparing=f_rg(),
         coord_bnum=jnp.full((R, G), INITIAL_BALLOT_NUM, I32),
         next_slot=z_rg(),
-        prop_req=jnp.full((R, G, W), NO_REQUEST, I32),
-        prop_slot=jnp.full((R, G, W), -1, I32),
-        prop_valid=f_rgw(),
-        prop_stop=f_rgw(),
-        member=jnp.zeros((G, R), BOOL),
+        prop_req=jnp.full((R, W, G), NO_REQUEST, I32),
+        prop_slot=jnp.full((R, W, G), -1, I32),
+        prop_valid=f_rwg(),
+        prop_stop=f_rwg(),
+        member=jnp.zeros((R, G), BOOL),
         n_members=jnp.zeros((G,), I32),
         epoch=jnp.zeros((G,), I32),
     )
@@ -150,13 +156,12 @@ def create_groups(state: PaxosState, rows: np.ndarray, members: np.ndarray,
         epochs = jnp.zeros((rows.shape[0],), I32)
     else:
         epochs = jnp.asarray(epochs, I32)
-    R, G, W = state.n_replica_slots, state.n_groups, state.window
 
     def col(a, fill):  # reset per-replica [R, G] column at `rows`
         return a.at[:, rows].set(fill)
 
-    def win(a, fill):  # reset [R, G, W] window at `rows`
-        return a.at[:, rows, :].set(fill)
+    def win(a, fill):  # reset [R, W, G] window at `rows`
+        return a.at[:, :, rows].set(fill)
 
     return state._replace(
         exec_slot=col(state.exec_slot, 0),
@@ -180,7 +185,7 @@ def create_groups(state: PaxosState, rows: np.ndarray, members: np.ndarray,
         prop_slot=win(state.prop_slot, -1),
         prop_valid=win(state.prop_valid, False),
         prop_stop=win(state.prop_stop, False),
-        member=state.member.at[rows, :].set(members),
+        member=state.member.at[:, rows].set(members.T),
         n_members=state.n_members.at[rows].set(
             jnp.sum(members, axis=1).astype(I32)
         ),
@@ -193,6 +198,6 @@ def free_groups(state: PaxosState, rows: np.ndarray) -> PaxosState:
     rows = jnp.asarray(rows, I32)
     return state._replace(
         status=state.status.at[:, rows].set(int(GroupStatus.FREE)),
-        member=state.member.at[rows, :].set(False),
+        member=state.member.at[:, rows].set(False),
         n_members=state.n_members.at[rows].set(0),
     )

@@ -254,8 +254,8 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True):
                 _, tick_num, placed, alive_b = rec
                 if tick_num < m.tick_num:
                     continue  # already inside the snapshot
-                req = np.zeros((m.R, m.G, m.P), np.int32)
-                stp = np.zeros((m.R, m.G, m.P), bool)
+                req = np.zeros((m.R, m.P, m.G), np.int32)
+                stp = np.zeros((m.R, m.P, m.G), bool)
                 m._placed = []
                 for row, entries in placed:
                     take = []
@@ -268,8 +268,8 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True):
                                 rid, m.rows.name(row) or "?", row, payload,
                                 stop, None, entry
                             )
-                        req[entry, row, p] = rid
-                        stp[entry, row, p] = stop
+                        req[entry, p, row] = rid
+                        stp[entry, p, row] = stop
                         take.append((rid, entry, p))
                     m._placed.append((row, take))
                     # a snapshot may hold queue copies of requests whose

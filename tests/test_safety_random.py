@@ -42,8 +42,8 @@ def run_random(seed, R=3, G=8, W=8, P=2, ticks=60, crash_prob=0.15,
         if majority_guard and alive.sum() < R // 2 + 1:
             alive[:] = True
 
-        req = np.zeros((R, G, P), np.int32)
-        stp = np.zeros((R, G, P), bool)
+        req = np.zeros((R, P, G), np.int32)
+        stp = np.zeros((R, P, G), bool)
         for g in range(G):
             # retry pending (rejected intake) first, then maybe a new request
             if rng.random() < 0.5:
@@ -53,7 +53,7 @@ def run_random(seed, R=3, G=8, W=8, P=2, ticks=60, crash_prob=0.15,
             live = [r for r in range(R) if alive[r]]
             for p, rid in enumerate(pending[g][: P]):
                 r = rng.choice(live) if live else 0
-                req[r, g, p % P] = rid
+                req[r, p % P, g] = rid
         ib = TickInbox(jnp.asarray(req), jnp.asarray(stp), jnp.asarray(alive.copy()))
         s, out = paxos_tick(s, ib)
 
@@ -63,7 +63,7 @@ def run_random(seed, R=3, G=8, W=8, P=2, ticks=60, crash_prob=0.15,
             for p, rid in enumerate(pending[g][: P]):
                 placed = False
                 for r in range(R):
-                    if req[r, g, p % P] == rid and taken[r, g, p % P]:
+                    if req[r, p % P, g] == rid and taken[r, p % P, g]:
                         placed = True
                 if not placed:
                     kept.append(rid)
@@ -76,7 +76,7 @@ def run_random(seed, R=3, G=8, W=8, P=2, ticks=60, crash_prob=0.15,
             for g in range(G):
                 for j in range(int(ec[r, g])):
                     slot = int(eb[r, g]) + j
-                    rid = int(er[r, g, j])
+                    rid = int(er[r, j, g])
                     assert slot not in executed[r][g], (
                         f"S3 violated: r{r} g{g} slot {slot} twice"
                     )

@@ -31,19 +31,19 @@ def inbox(R=3, G=4, P=4, reqs=(), stops=(), alive=None):
     slot_ctr = {}
     for r, g, rid in reqs:
         p = slot_ctr.get((r, g), 0)
-        req[r, g, p] = rid
+        req[r, p, g] = rid
         slot_ctr[(r, g)] = p + 1
     for r, g, rid in stops:
         p = slot_ctr.get((r, g), 0)
-        req[r, g, p] = rid
-        stp[r, g, p] = True
+        req[r, p, g] = rid
+        stp[r, p, g] = True
         slot_ctr[(r, g)] = p + 1
     al = np.ones(R, bool) if alive is None else np.array(alive, bool)
     return TickInbox(jnp.asarray(req), jnp.asarray(stp), jnp.asarray(al))
 
 
 def executed_ids(out, r, g):
-    row = np.array(out.exec_req[r, g])
+    row = np.array(out.exec_req[r, :, g])
     n = int(out.exec_count[r, g])
     return [int(x) for x in row if x != 0][: n + 1]
 
@@ -64,7 +64,7 @@ def test_single_request_commits_in_one_tick():
     for r in range(3):
         assert executed_ids(out, r, 2) == [77]
     assert np.all(np.array(s.exec_slot[:, 2]) == 1)
-    assert np.array(out.intake_taken[1, 2, 0])
+    assert np.array(out.intake_taken[1, 0, 2])
     # other groups idle
     assert int(out.exec_count[0, 0]) == 0
 
@@ -97,12 +97,12 @@ def test_stop_request_stops_group():
     s = mk()
     s, out = paxos_tick(s, inbox(stops=[(0, 3, 55)]))
     assert executed_ids(out, 0, 3) == [55]
-    assert np.all(np.array(out.exec_stop[0, 3])[:1])
+    assert np.all(np.array(out.exec_stop[0, :, 3])[:1])
     assert np.all(np.array(s.status[:, 3]) == int(GroupStatus.STOPPED))
     # further proposals rejected
     s, out = paxos_tick(s, inbox(reqs=[(0, 3, 56)]))
     assert int(out.exec_count[0, 3]) == 0
-    assert not np.array(out.intake_taken[0, 3, 0])
+    assert not np.array(out.intake_taken[0, 0, 3])
 
 
 def test_no_quorum_with_minority_alive():

@@ -23,23 +23,27 @@ def ring_index(slots, window: int):
 
 
 def window_slots(exec_slot, window: int):
-    """``[..., W]`` array of the absolute slots covered by each group's window,
-    position j = exec_slot + j."""
+    """Absolute slots covered by each group's window, in window order.
+
+    ``exec_slot``: ``[..., G]`` -> ``[..., W, G]`` with plane j holding
+    exec_slot + j (plane axis = second-to-last, per the module layout)."""
     ar = jnp.arange(window, dtype=jnp.int32)
-    return exec_slot[..., None] + ar
+    return exec_slot[..., None, :] + ar[:, None]
 
 
 def in_window(slots, exec_slot, window: int):
-    """True where ``slots`` fall inside [exec_slot, exec_slot+W) (wraparound-
-    aware)."""
-    d = (slots - exec_slot).astype(jnp.int32)
+    """True where ``slots`` (``[..., W, G]``) fall inside
+    [exec_slot, exec_slot+W) for their group (wraparound-aware);
+    ``exec_slot``: ``[..., G]``."""
+    d = (slots - exec_slot[..., None, :]).astype(jnp.int32)
     return (d >= 0) & (d < window)
 
 
 def leading_run(valid):
-    """Number of leading True along the last axis (per group): how many
-    consecutive in-order entries are ready.  ``valid``: bool ``[..., W]``."""
-    return jnp.sum(jnp.cumprod(valid.astype(jnp.int32), axis=-1), axis=-1)
+    """Number of leading True along the plane (second-to-last) axis per
+    group: how many consecutive in-order entries are ready.
+    ``valid``: bool ``[..., W, G]`` -> int32 ``[..., G]``."""
+    return jnp.sum(jnp.cumprod(valid.astype(jnp.int32), axis=-2), axis=-2)
 
 
 def gather_planes(arr, idx):

@@ -1,0 +1,3 @@
+from .executor import ProtocolExecutor, ProtocolTask, ThresholdProtocolTask
+
+__all__ = ["ProtocolExecutor", "ProtocolTask", "ThresholdProtocolTask"]

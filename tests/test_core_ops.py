@@ -28,13 +28,16 @@ def test_slot_wraparound():
 
 
 def test_window_ring_and_leading_run():
+    # plane-axis convention: [..., W, G] with G minor (one group here)
     W = 8
-    exec_slot = jnp.array([[5]])
-    slots = w.window_slots(exec_slot, W)
-    assert list(np.array(slots)[0, 0]) == list(range(5, 13))
-    assert list(np.array(w.ring_index(slots, W))[0, 0]) == [5, 6, 7, 0, 1, 2, 3, 4]
-    valid = jnp.array([[True, True, False, True]])
-    assert int(w.leading_run(valid)[0]) == 2
+    exec_slot = jnp.array([[5]])  # [1, G=1]
+    slots = w.window_slots(exec_slot, W)  # [1, W, 1]
+    assert list(np.array(slots)[0, :, 0]) == list(range(5, 13))
+    assert list(np.array(w.ring_index(slots, W))[0, :, 0]) == [5, 6, 7, 0, 1, 2, 3, 4]
+    inw = w.in_window(slots, exec_slot, W)
+    assert bool(np.array(inw).all())
+    valid = jnp.array([[True], [True], [False], [True]])[None]  # [1, W=4, G=1]
+    assert int(w.leading_run(valid)[0, 0]) == 2
 
 
 def test_config_properties_roundtrip(tmp_path):

@@ -1,0 +1,174 @@
+"""Protocol-task runtime tests (SURVEY §2.6).
+
+Mirrors the reference's protocoltask examples (pingpong / thresholdfetch,
+``protocoltask/examples``): tasks emit messages through a collected send
+function; restarts provide liveness under drops.
+"""
+
+import threading
+import time
+
+from gigapaxos_tpu.protocoltask import (
+    ProtocolExecutor,
+    ProtocolTask,
+    ThresholdProtocolTask,
+)
+from gigapaxos_tpu.utils.profiler import DelayProfiler, Sampler
+
+
+class Collector:
+    def __init__(self):
+        self.sent = []
+        self.lock = threading.Lock()
+
+    def __call__(self, dest, packet):
+        with self.lock:
+            self.sent.append((dest, packet))
+
+    def count(self):
+        with self.lock:
+            return len(self.sent)
+
+
+class OneShot(ProtocolTask):
+    period_s = 0.05
+
+    def __init__(self, key):
+        self._key = key
+        self.done_called = 0
+
+    @property
+    def key(self):
+        return self._key
+
+    def start(self):
+        return [(1, {"type": "ping", "key": self._key})]
+
+    def handle(self, event):
+        return [(2, {"type": "done"})], True
+
+    def on_done(self):
+        self.done_called += 1
+
+
+class Fetch(ThresholdProtocolTask):
+    period_s = 0.05
+
+    def __init__(self, nodes, threshold=None):
+        super().__init__(nodes, threshold)
+        self.fired = []
+
+    @property
+    def key(self):
+        return "fetch"
+
+    def make_request(self, node):
+        return {"type": "fetch", "to": node}
+
+    def on_threshold(self, replies):
+        self.fired.append(replies)
+        return [(0, {"type": "fetched", "n": len(replies)})]
+
+
+def test_schedule_restart_until_handled():
+    c = Collector()
+    ex = ProtocolExecutor(c)
+    t = OneShot("a")
+    assert ex.schedule(t)
+    assert not ex.schedule(OneShot("a"))  # idempotent by key
+    time.sleep(0.2)  # several restart periods
+    n = c.count()
+    assert n >= 2  # initial send + at least one restart
+    assert ex.handle_event("a", {"sender": 1})
+    assert t.done_called == 1
+    assert not ex.is_running("a")
+    # no further restarts after done
+    time.sleep(0.12)
+    m = c.count()
+    time.sleep(0.12)
+    assert c.count() == m
+    ex.stop()
+
+
+def test_stale_event_dropped_and_cancel():
+    c = Collector()
+    ex = ProtocolExecutor(c)
+    assert not ex.handle_event("nope", {"sender": 1})
+    t = OneShot("b")
+    ex.schedule(t)
+    assert ex.cancel("b")
+    assert not ex.cancel("b")
+    assert not ex.handle_event("b", {"sender": 1})
+    assert t.done_called == 0
+    ex.stop()
+
+
+def test_threshold_task_majority():
+    c = Collector()
+    ex = ProtocolExecutor(c)
+    t = Fetch(nodes=[0, 1, 2])  # majority = 2
+    ex.schedule(t)
+    assert ex.handle_event("fetch", {"sender": 0, "v": "x"})
+    assert t.fired == []  # 1 < 2
+    # duplicate reply does not advance the count
+    ex.handle_event("fetch", {"sender": 0, "v": "x2"})
+    assert t.fired == []
+    ex.handle_event("fetch", {"sender": 2, "v": "y"})
+    assert len(t.fired) == 1 and set(t.fired[0]) == {0, 2}
+    assert not ex.is_running("fetch")
+    # the on_threshold follow-up got sent
+    assert any(p.get("type") == "fetched" for _, p in c.sent)
+    ex.stop()
+
+
+def test_threshold_restart_polls_only_stragglers():
+    c = Collector()
+    ex = ProtocolExecutor(c)
+    t = Fetch(nodes=[0, 1, 2], threshold=3)
+    ex.schedule(t)
+    ex.handle_event("fetch", {"sender": 0})
+    ex.handle_event("fetch", {"sender": 1})
+    time.sleep(0.15)
+    with c.lock:
+        polled_after = [d for d, p in c.sent[3:] if p.get("type") == "fetch"]
+    assert polled_after and set(polled_after) == {2}
+    ex.stop()
+
+
+def test_max_restarts_expiry():
+    class Bounded(OneShot):
+        period_s = 0.03
+        max_restarts = 2
+
+    c = Collector()
+    ex = ProtocolExecutor(c)
+    t = Bounded("x")
+    ex.schedule(t)
+    deadline = time.monotonic() + 2
+    while t.done_called == 0 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert t.done_called == 1  # expired via max_restarts
+    assert not ex.is_running("x")
+    ex.stop()
+
+
+def test_profiler_ewma_and_stats():
+    p = DelayProfiler(alpha=0.5)
+    t0 = time.monotonic() - 0.010
+    p.update_delay("op", t0)
+    assert p.get("op") >= 10.0
+    p.update_mov_avg("q", 4.0)
+    p.update_mov_avg("q", 8.0)
+    assert abs(p.get("q") - 6.0) < 1e-9
+    p.update_count("n", 3)
+    assert p.get("n") == 3.0
+    s = p.get_stats()
+    assert "op:" in s and "q:" in s and "n:3" in s
+    p.clear()
+    assert p.get("op") is None
+
+
+def test_sampler_gate():
+    s = Sampler(10)
+    hits = sum(1 for _ in range(100) if s())
+    assert hits == 10

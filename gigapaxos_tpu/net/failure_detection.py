@@ -1,0 +1,126 @@
+"""Keep-alive failure detection over the messenger.
+
+Analog of ``gigapaxos/FailureDetection.java:45-60``: each node periodically
+pings the nodes it monitors; a node is up iff heard from within a timeout.
+Same design decisions as the reference:
+
+* per-*node* (host) detection, never per-group — one pinger covers every
+  group two nodes share (class doc FailureDetection.java:50-55);
+* ping rate capped (``:63-66``: max 1/100ms) with the timeout a multiple of
+  the ping interval;
+* ``heardFrom`` is fed by *any* inbound packet, not just pongs — real
+  traffic is implicit keep-alive (``heardFrom :248``).
+
+TPU-specific role (SURVEY §2.1 FailureDetection row): the aggregate liveness
+view is exported as a dense bool ``[R]`` mask (``alive_mask``) and uploaded
+into the tick inbox, where it drives the branch-free coordinator-election
+phase (ops/tick.py phase 0) — the device-side analog of
+``checkRunForCoordinator`` consulting ``isNodeUp``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from .messenger import Messenger
+
+PING = "fd_ping"
+PONG = "fd_pong"
+
+
+class FailureDetection:
+    def __init__(
+        self,
+        messenger: Messenger,
+        monitored: Iterable[str] = (),
+        ping_interval_s: float = 0.1,
+        timeout_s: float = 3.0,
+        on_change: Optional[Callable[[str, bool], None]] = None,
+    ):
+        self.m = messenger
+        self.me = messenger.node_id
+        self.ping_interval_s = max(ping_interval_s, 0.01)
+        self.timeout_s = max(timeout_s, 2 * self.ping_interval_s)
+        self.on_change = on_change
+        self._lock = threading.Lock()
+        self._monitored: List[str] = []
+        self._last_heard: Dict[str, float] = {}
+        self._was_up: Dict[str, bool] = {}
+        self._stop = threading.Event()
+        messenger.register(PING, self._on_ping)
+        messenger.register(PONG, self._on_pong)
+        for n in monitored:
+            self.monitor(n)
+        self._thread = threading.Thread(
+            target=self._run, name=f"fd-{self.me}", daemon=True
+        )
+        self._thread.start()
+
+    # ----------------------------------------------------------------- public
+    def monitor(self, node: str) -> None:
+        """Start monitoring (idempotent).  A just-added node gets a grace
+        window of one timeout before being reported down — the reference
+        likewise initializes lastHeardFrom on first monitor."""
+        if node == self.me:
+            return
+        with self._lock:
+            if node not in self._monitored:
+                self._monitored.append(node)
+                self._last_heard.setdefault(node, time.monotonic())
+                self._was_up.setdefault(node, True)
+
+    def unmonitor(self, node: str) -> None:
+        with self._lock:
+            if node in self._monitored:
+                self._monitored.remove(node)
+
+    def heard_from(self, node: str) -> None:
+        """Feed from any inbound packet (wire into the demux default path)."""
+        now = time.monotonic()
+        with self._lock:
+            self._last_heard[node] = now
+
+    def is_node_up(self, node: str) -> bool:
+        """``isNodeUp`` (FailureDetection.java:252-258); self is always up."""
+        if node == self.me:
+            return True
+        with self._lock:
+            last = self._last_heard.get(node)
+        return last is not None and (time.monotonic() - last) < self.timeout_s
+
+    def alive_mask(self, nodes: List[str]) -> np.ndarray:
+        """Dense liveness view for the tick inbox: nodes[i] -> alive[i]."""
+        return np.array([self.is_node_up(n) for n in nodes], dtype=bool)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+    # ---------------------------------------------------------------- private
+    def _on_ping(self, sender: str, packet: dict) -> None:
+        self.heard_from(sender)
+        self.m.send(sender, {"type": PONG})
+
+    def _on_pong(self, sender: str, packet: dict) -> None:
+        self.heard_from(sender)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.ping_interval_s):
+            with self._lock:
+                targets = list(self._monitored)
+            for n in targets:
+                self.m.send(n, {"type": PING})
+            # edge-triggered up/down notifications
+            if self.on_change is not None:
+                for n in targets:
+                    up = self.is_node_up(n)
+                    if self._was_up.get(n) != up:
+                        self._was_up[n] = up
+                        try:
+                            self.on_change(n, up)
+                        except Exception:
+                            pass

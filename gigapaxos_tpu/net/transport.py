@@ -1,0 +1,310 @@
+"""Node-addressed, reconnecting, framed TCP transport (the DCN path).
+
+Analog of the reference's NIO stack (``nio/NIOTransport.java:65-114`` +
+``MessageNIOTransport.java:72``): a byte-stream transport with
+
+* length-prefixed framing (``MessageExtractor`` analog);
+* node-ID addressing — one outbound connection per peer, created lazily,
+  with a bounded send queue and **reconnect-on-failure** (the reference's
+  pendingWrites/pendingConnects queues);
+* loopback short-circuit for self-sends (``sendOrLoopback``,
+  PaxosManager.java:2116-2128);
+* an identifying hello frame so receivers know the sender's node id.
+
+Role in the TPU framework (SURVEY §2.2): this carries *host-level* traffic —
+client edge, reconfiguration control plane, failure-detection keep-alives,
+checkpoint transfer.  Replica-axis quorum traffic inside a mesh program rides
+ICI collectives instead (ops/tick.py) and never touches this module.
+
+Threads: one acceptor per endpoint, one reader per inbound connection, one
+writer per outbound peer.  The reference runs a single selector thread; on the
+host control plane connection counts are small (nodes, not groups), so
+thread-per-connection is simpler and plenty.
+
+Wire format per frame: ``[u32 len][u8 kind][payload:len-1]``; kind 0 = JSON
+(control plane), kind 1 = raw bytes (bulk data, e.g. checkpoint blobs).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..utils.profiler import profiler
+
+KIND_JSON = 0
+KIND_BYTES = 1
+
+_HDR = struct.Struct(">IB")  # frame length (kind+payload), kind
+
+#: Maximum frame payload (sanity bound, mirrors MAX_PAYLOAD_SIZE fragmentation
+#: pressure in the reference — large states use CHECKPOINT chunking above).
+MAX_FRAME = 64 * 1024 * 1024
+
+
+class SendFailure(Exception):
+    pass
+
+
+def _send_frame(sock: socket.socket, kind: int, payload: bytes) -> None:
+    sock.sendall(_HDR.pack(len(payload) + 1, kind) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> Optional[Tuple[int, bytes]]:
+    hdr = _recv_exact(sock, _HDR.size)
+    if hdr is None:
+        return None
+    ln, kind = _HDR.unpack(hdr)
+    if ln < 1 or ln - 1 > MAX_FRAME:
+        return None
+    payload = _recv_exact(sock, ln - 1) if ln > 1 else b""
+    if payload is None:
+        return None
+    return kind, payload
+
+
+class _Peer:
+    """Outbound link to one node: queue + writer thread + reconnect."""
+
+    def __init__(self, transport: "Transport", dest: str):
+        self.t = transport
+        self.dest = dest
+        self.q: "queue.Queue[Tuple[int, bytes]]" = queue.Queue(
+            maxsize=transport.send_queue_cap
+        )
+        self.sock: Optional[socket.socket] = None
+        self.thread = threading.Thread(
+            target=self._run, name=f"tx-{transport.node_id}->{dest}", daemon=True
+        )
+        self.thread.start()
+
+    def _connect(self) -> Optional[socket.socket]:
+        addr = self.t.resolve(self.dest)
+        if addr is None:
+            return None
+        try:
+            s = socket.create_connection(addr, timeout=self.t.connect_timeout_s)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            hello = json.dumps({"node": self.t.node_id}).encode()
+            _send_frame(s, KIND_JSON, hello)
+            return s
+        except OSError:
+            return None
+
+    def _run(self) -> None:
+        backoff = 0.05
+        while not self.t.closed:
+            try:
+                kind, payload = self.q.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            # retry the same frame across reconnects until sent or give up
+            attempts = 0
+            while not self.t.closed:
+                if self.sock is None:
+                    self.sock = self._connect()
+                    if self.sock is None:
+                        attempts += 1
+                        if attempts > self.t.max_connect_attempts:
+                            self.t._count("dropped")
+                            break
+                        time.sleep(min(backoff * (2 ** attempts), 2.0))
+                        continue
+                    backoff = 0.05
+                try:
+                    _send_frame(self.sock, kind, payload)
+                    self.t._count("sent")
+                    break
+                except OSError:
+                    try:
+                        self.sock.close()
+                    except OSError:
+                        pass
+                    self.sock = None  # reconnect and retry this frame
+
+    def close(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+
+class Transport:
+    """One node's endpoint: listener + peers table.
+
+    ``demux(sender_id, kind, payload)`` is called on reader threads for every
+    inbound frame (like the reference's AbstractPacketDemultiplexer handing
+    packets to handlers, ``nio/AbstractPacketDemultiplexer.java:48``).
+
+    ``resolve(node_id) -> (host, port)`` maps node ids to addresses — pass
+    the NodeConfig-backed lookup; late binding means nodes may join after
+    this endpoint starts (elastic node add, SURVEY §5).
+    """
+
+    def __init__(
+        self,
+        node_id: str,
+        bind: Tuple[str, int],
+        demux: Callable[[str, int, bytes], None],
+        resolve: Callable[[str], Optional[Tuple[str, int]]],
+        send_queue_cap: int = 4096,
+        connect_timeout_s: float = 2.0,
+        max_connect_attempts: int = 5,
+    ):
+        self.node_id = node_id
+        self.demux = demux
+        self.resolve = resolve
+        self.send_queue_cap = send_queue_cap
+        self.connect_timeout_s = connect_timeout_s
+        self.max_connect_attempts = max_connect_attempts
+        self.closed = False
+        self._peers: Dict[str, _Peer] = {}
+        self._plock = threading.Lock()
+        self._readers: list = []
+        self.stats: Dict[str, int] = {}
+        self._slock = threading.Lock()
+
+        self._server = socket.create_server(bind, reuse_port=False)
+        self._server.settimeout(0.25)
+        self.port = self._server.getsockname()[1]
+        self._acceptor = threading.Thread(
+            target=self._accept_loop, name=f"accept-{node_id}", daemon=True
+        )
+        self._acceptor.start()
+
+    # ------------------------------------------------------------------ sends
+    def send(self, dest: str, obj: Any) -> None:
+        """Send a JSON-serializable control packet to node ``dest``."""
+        self.send_raw(dest, KIND_JSON, json.dumps(obj).encode())
+
+    def send_bytes(self, dest: str, payload: bytes) -> None:
+        self.send_raw(dest, KIND_BYTES, payload)
+
+    def send_raw(self, dest: str, kind: int, payload: bytes) -> None:
+        if self.closed:
+            raise SendFailure("transport closed")
+        if dest == self.node_id:
+            # loopback short-circuit: no socket, no serialization round-trip
+            # beyond the bytes already built (keeps ordering with real sends
+            # unnecessary — the reference short-circuits identically)
+            self._count("loopback")
+            self.demux(self.node_id, kind, payload)
+            return
+        with self._plock:
+            peer = self._peers.get(dest)
+            if peer is None:
+                peer = self._peers[dest] = _Peer(self, dest)
+        try:
+            peer.q.put_nowait((kind, payload))
+        except queue.Full:
+            # backpressure: drop-newest, callers with liveness needs retry via
+            # protocol tasks (congestion handling, PaxosManager.java:920-935)
+            self._count("backpressure_drop")
+
+    # ---------------------------------------------------------------- receive
+    def _accept_loop(self) -> None:
+        while not self.closed:
+            try:
+                conn, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            r = threading.Thread(
+                target=self._read_loop, args=(conn,), daemon=True
+            )
+            r.start()
+            self._readers.append(r)
+
+    def _read_loop(self, conn: socket.socket) -> None:
+        sender = "?"
+        try:
+            first = _recv_frame(conn)
+            if first is None:
+                return
+            kind, payload = first
+            try:
+                sender = json.loads(payload.decode()).get("node", "?")
+            except (ValueError, AttributeError):
+                return  # bad hello; drop connection
+            while not self.closed:
+                frame = _recv_frame(conn)
+                if frame is None:
+                    return
+                kind, payload = frame
+                self._count("rcvd")
+                t0 = time.monotonic()
+                try:
+                    self.demux(sender, kind, payload)
+                except Exception:
+                    # handler bugs must not kill the reader (the reference
+                    # logs and continues, AbstractPacketDemultiplexer)
+                    self._count("demux_errors")
+                profiler.update_delay("net.demux", t0)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------------ admin
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._slock:
+            self.stats[key] = self.stats.get(key, 0) + n
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        with self._plock:
+            for p in self._peers.values():
+                p.close()
+        self._acceptor.join(timeout=2)
+
+
+class JsonDemux:
+    """Packet-type demultiplexer: routes JSON packets by their ``type`` field
+    to registered handlers (``AbstractPacketDemultiplexer.java:48`` analog).
+
+    Use as the ``demux`` callable of a Transport.  Handlers receive
+    ``(sender_id, packet_dict)``.  Raw-bytes frames go to ``bytes_handler``.
+    """
+
+    def __init__(self):
+        self._handlers: Dict[Any, Callable[[str, dict], None]] = {}
+        self.bytes_handler: Optional[Callable[[str, bytes], None]] = None
+        self.default_handler: Optional[Callable[[str, dict], None]] = None
+
+    def register(self, ptype, handler: Callable[[str, dict], None]) -> None:
+        self._handlers[ptype] = handler
+
+    def __call__(self, sender: str, kind: int, payload: bytes) -> None:
+        if kind == KIND_BYTES:
+            if self.bytes_handler is not None:
+                self.bytes_handler(sender, payload)
+            return
+        packet = json.loads(payload.decode())
+        h = self._handlers.get(packet.get("type"))
+        if h is not None:
+            h(sender, packet)
+        elif self.default_handler is not None:
+            self.default_handler(sender, packet)

@@ -103,7 +103,7 @@ class ProtocolExecutor:
                 return False
             self._tasks[task.key] = task
             self._restarts[task.key] = 0
-            self._push(task.key, task.period_s)
+            self._push(task.key, task, task.period_s)
         self._emit(task.start())
         return True
 
@@ -127,17 +127,20 @@ class ProtocolExecutor:
             task = self._tasks.get(key)
         if task is None:
             return False
+        # lock order everywhere: task lock outer, registry lock inner
         with _task_lock(task):
             with self._lock:
                 if self._tasks.get(key) is not task:
                     return False  # completed/canceled while we waited
             msgs, done = task.handle(event)
+            if done:
+                # atomic done-transition under the task lock: nobody else can
+                # observe the task as live after this point
+                with self._lock:
+                    self._tasks.pop(key, None)
+                    self._restarts.pop(key, None)
+                task.on_done()
         self._emit(msgs)
-        if done:
-            with self._lock:
-                self._tasks.pop(key, None)
-                self._restarts.pop(key, None)
-            task.on_done()
         return True
 
     def pending(self) -> List[str]:
@@ -156,42 +159,42 @@ class ProtocolExecutor:
         for dest, packet in msgs:
             self._send(dest, packet)
 
-    def _push(self, key: str, delay: float) -> None:
+    def _push(self, key: str, task: "ProtocolTask", delay: float) -> None:
+        # the task identity in the entry makes stale timers (from a canceled
+        # registration whose key was reused) self-invalidating
         self._seq += 1
-        heapq.heappush(self._heap, (time.monotonic() + delay, self._seq, key))
+        heapq.heappush(
+            self._heap, (time.monotonic() + delay, self._seq, key, task)
+        )
         self._cv.notify_all()
 
     def _run(self) -> None:
         while True:
             fire: Optional[ProtocolTask] = None
+            expired: Optional[ProtocolTask] = None
             with self._cv:
                 if self._stopped:
                     return
                 if not self._heap:
                     self._cv.wait(timeout=0.5)
                     continue
-                deadline, _, key = self._heap[0]
+                deadline, _, key, task = self._heap[0]
                 now = time.monotonic()
                 if deadline > now:
                     self._cv.wait(timeout=deadline - now)
                     continue
                 heapq.heappop(self._heap)
-                task = self._tasks.get(key)
-                if task is None:
-                    continue
+                if self._tasks.get(key) is not task:
+                    continue  # stale entry: canceled/completed registration
                 self._restarts[key] = self._restarts.get(key, 0) + 1
                 if (
                     task.max_restarts is not None
                     and self._restarts[key] > task.max_restarts
                 ):
-                    self._tasks.pop(key, None)
-                    self._restarts.pop(key, None)
-                    fire = None
                     expired = task
                 else:
-                    expired = None
                     fire = task
-                    self._push(key, task.period_s)
+                    self._push(key, task, task.period_s)
             if fire is not None:
                 try:
                     with _task_lock(fire):
@@ -205,7 +208,14 @@ class ProtocolExecutor:
                     pass
             elif expired is not None:
                 try:
-                    expired.on_done()
+                    with _task_lock(expired):
+                        with self._lock:
+                            live = self._tasks.get(key) is expired
+                            if live:
+                                self._tasks.pop(key, None)
+                                self._restarts.pop(key, None)
+                        if live:
+                            expired.on_done()
                 except Exception:
                     pass
 

@@ -30,6 +30,7 @@ class DelayProfiler:
         self.alpha = alpha
         self._lock = threading.Lock()
         self._avg: Dict[str, float] = {}
+        self._unit: Dict[str, str] = {}  # "ms" for delays, "" for raw EWMAs
         self._n: Dict[str, int] = {}
         self._count: Dict[str, int] = {}
         self._rate: Dict[str, float] = {}
@@ -48,11 +49,13 @@ class DelayProfiler:
         sample_ms = (time.monotonic() - t0) * 1000.0 / max(n, 1)
         with self._lock:
             self._ewma(self._avg, key, sample_ms)
+            self._unit[key] = "ms"
             self._n[key] = self._n.get(key, 0) + n
 
     def update_mov_avg(self, key: str, value: float) -> None:
         with self._lock:
             self._ewma(self._avg, key, float(value))
+            self._unit.setdefault(key, "")
             self._n[key] = self._n.get(key, 0) + 1
 
     def update_rate(self, key: str, n: int = 1) -> None:
@@ -80,7 +83,10 @@ class DelayProfiler:
     def get_stats(self) -> str:
         """One-line summary, the ``DelayProfiler.getStats()`` idiom."""
         with self._lock:
-            parts = [f"{k}:{v:.2f}ms[{self._n.get(k, 0)}]" for k, v in sorted(self._avg.items())]
+            parts = [
+                f"{k}:{v:.2f}{self._unit.get(k, '')}[{self._n.get(k, 0)}]"
+                for k, v in sorted(self._avg.items())
+            ]
             parts += [f"{k}:{v:.1f}/s" for k, v in sorted(self._rate.items())]
             parts += [f"{k}:{v}" for k, v in sorted(self._count.items())]
         return " ".join(parts)
@@ -88,6 +94,7 @@ class DelayProfiler:
     def clear(self) -> None:
         with self._lock:
             self._avg.clear()
+            self._unit.clear()
             self._n.clear()
             self._count.clear()
             self._rate.clear()

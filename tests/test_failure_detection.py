@@ -1,0 +1,101 @@
+"""Failure-detection tests: real sockets, crash = close the messenger."""
+
+import time
+
+import numpy as np
+
+from gigapaxos_tpu.net import Messenger, NodeMap
+from gigapaxos_tpu.net.failure_detection import FailureDetection
+
+
+def cluster(ids, ping=0.05, timeout=0.4):
+    nm = NodeMap()
+    ms = {nid: Messenger(nid, ("127.0.0.1", 0), nm) for nid in ids}
+    for nid, m in ms.items():
+        nm.add(nid, "127.0.0.1", m.port)
+    fds = {
+        nid: FailureDetection(
+            m, [x for x in ids if x != nid], ping_interval_s=ping, timeout_s=timeout
+        )
+        for nid, m in ms.items()
+    }
+    return nm, ms, fds
+
+
+def test_all_up_then_crash_then_recover():
+    ids = ["A", "B", "C"]
+    nm, ms, fds = cluster(ids)
+    try:
+        time.sleep(0.3)
+        assert all(fds["A"].is_node_up(n) for n in ids)
+        assert list(fds["A"].alive_mask(ids)) == [True, True, True]
+
+        # crash B: close its messenger (no more pongs)
+        port_b = ms["B"].port
+        fds["B"].close()
+        ms["B"].close()
+        deadline = time.monotonic() + 5
+        while fds["A"].is_node_up("B") and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not fds["A"].is_node_up("B")
+        assert not fds["C"].is_node_up("B")
+        assert fds["A"].is_node_up("C") and fds["C"].is_node_up("A")
+        mask = fds["A"].alive_mask(ids)
+        assert list(mask) == [True, False, True] and mask.dtype == np.bool_
+
+        # recover B on the same port
+        ms["B"] = Messenger("B", ("127.0.0.1", port_b), nm)
+        fds["B"] = FailureDetection(
+            ms["B"], ["A", "C"], ping_interval_s=0.05, timeout_s=0.4
+        )
+        deadline = time.monotonic() + 5
+        while not fds["A"].is_node_up("B") and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert fds["A"].is_node_up("B")
+    finally:
+        for f in fds.values():
+            f.close()
+        for m in ms.values():
+            m.close()
+
+
+def test_on_change_edges():
+    events = []
+    nm = NodeMap()
+    a = Messenger("A", ("127.0.0.1", 0), nm)
+    nm.add("A", "127.0.0.1", a.port)
+    # monitor a node that never existed: one down edge after the grace window
+    fd = FailureDetection(
+        a,
+        ["GHOST"],
+        ping_interval_s=0.05,
+        timeout_s=0.3,
+        on_change=lambda n, up: events.append((n, up)),
+    )
+    try:
+        deadline = time.monotonic() + 5
+        while not events and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert events and events[0] == ("GHOST", False)
+        n_down = len(events)
+        time.sleep(0.3)
+        assert len(events) == n_down  # edge-triggered, not repeated
+    finally:
+        fd.close()
+        a.close()
+
+
+def test_self_always_up_and_unmonitor():
+    nm = NodeMap()
+    a = Messenger("A", ("127.0.0.1", 0), nm)
+    nm.add("A", "127.0.0.1", a.port)
+    fd = FailureDetection(a, [], ping_interval_s=0.05, timeout_s=0.3)
+    try:
+        assert fd.is_node_up("A")
+        fd.monitor("A")  # no-op
+        fd.monitor("X")
+        fd.unmonitor("X")
+        assert "X" not in fd._monitored
+    finally:
+        fd.close()
+        a.close()

@@ -1,0 +1,178 @@
+"""Transport/messenger tests: loopback sockets, reconnect, demux routing.
+
+Mirrors the reference's NIO tests (``nio/nioutils/NIOTester*.java``): real
+sockets on 127.0.0.1, no mocks.
+"""
+
+import threading
+import time
+
+from gigapaxos_tpu.net import JsonDemux, Messenger, NodeMap
+
+
+class Sink:
+    def __init__(self):
+        self.got = []
+        self.cv = threading.Condition()
+
+    def __call__(self, sender, packet):
+        with self.cv:
+            self.got.append((sender, packet))
+            self.cv.notify_all()
+
+    def wait_for(self, n, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        with self.cv:
+            while len(self.got) < n:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self.cv.wait(timeout=left)
+        return True
+
+
+def make_pair():
+    nm = NodeMap()
+    a = Messenger("A", ("127.0.0.1", 0), nm)
+    b = Messenger("B", ("127.0.0.1", 0), nm)
+    nm.add("A", "127.0.0.1", a.port)
+    nm.add("B", "127.0.0.1", b.port)
+    return nm, a, b
+
+
+def test_send_recv_and_sender_stamp():
+    nm, a, b = make_pair()
+    try:
+        sink = Sink()
+        b.register("hello", sink)
+        a.send("B", {"type": "hello", "x": 1})
+        assert sink.wait_for(1)
+        sender, pkt = sink.got[0]
+        assert sender == "A" and pkt["sender"] == "A" and pkt["x"] == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_loopback_short_circuit():
+    nm, a, b = make_pair()
+    try:
+        sink = Sink()
+        a.register("self", sink)
+        a.send("A", {"type": "self"})
+        assert sink.wait_for(1, timeout=1)
+        assert a.transport.stats.get("loopback") == 1
+        assert a.transport.stats.get("sent") is None  # no socket involved
+    finally:
+        a.close()
+        b.close()
+
+
+def test_multicast_and_bytes():
+    nm = NodeMap()
+    nodes = {nid: Messenger(nid, ("127.0.0.1", 0), nm) for nid in "ABC"}
+    for nid, m in nodes.items():
+        nm.add(nid, "127.0.0.1", m.port)
+    try:
+        sinks = {}
+        for nid, m in nodes.items():
+            sinks[nid] = Sink()
+            m.register("mc", sinks[nid])
+        nodes["A"].multicast(["A", "B", "C"], {"type": "mc"})
+        for nid in "ABC":
+            assert sinks[nid].wait_for(1), nid
+        # raw bytes path
+        blob = []
+        ev = threading.Event()
+
+        def on_bytes(sender, payload):
+            blob.append((sender, payload))
+            ev.set()
+
+        nodes["B"].demux.bytes_handler = on_bytes
+        nodes["A"].send_bytes("B", b"\x00\x01binary")
+        assert ev.wait(5)
+        assert blob[0] == ("A", b"\x00\x01binary")
+    finally:
+        for m in nodes.values():
+            m.close()
+
+
+def test_reconnect_after_peer_restart():
+    nm = NodeMap()
+    a = Messenger("A", ("127.0.0.1", 0), nm)
+    b = Messenger("B", ("127.0.0.1", 0), nm)
+    nm.add("A", "127.0.0.1", a.port)
+    nm.add("B", "127.0.0.1", b.port)
+    sink = Sink()
+    b.register("m", sink)
+    try:
+        a.send("B", {"type": "m", "i": 0})
+        assert sink.wait_for(1)
+        # "crash" B and restart it on the same port
+        port = b.port
+        b.close()
+        time.sleep(0.1)
+        b2 = Messenger("B", ("127.0.0.1", port), nm)
+        sink2 = Sink()
+        b2.register("m", sink2)
+        # a frame written into the dead socket can be silently lost (TCP
+        # buffers it before the RST arrives) — end-to-end liveness is the
+        # protocol-task layer's job, so retry like one until delivery; the
+        # transport must reconnect underneath without intervention
+        deadline = time.monotonic() + 10
+        i = 0
+        while not sink2.got and time.monotonic() < deadline:
+            i += 1
+            a.send("B", {"type": "m", "i": i})
+            time.sleep(0.1)
+        assert sink2.wait_for(1, timeout=1)
+        b2.close()
+    finally:
+        a.close()
+
+
+def test_unknown_type_goes_to_default_handler():
+    nm, a, b = make_pair()
+    try:
+        sink = Sink()
+        b.demux.default_handler = sink
+        a.send("B", {"type": "mystery"})
+        assert sink.wait_for(1)
+        assert sink.got[0][1]["type"] == "mystery"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_unresolvable_destination_drops_without_crash():
+    nm, a, b = make_pair()
+    try:
+        a.send("GHOST", {"type": "m"})  # no address for GHOST
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline:
+            if a.transport.stats.get("dropped", 0) >= 1:
+                break
+            time.sleep(0.05)
+        assert a.transport.stats.get("dropped", 0) >= 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_demux_handler_exception_does_not_kill_reader():
+    nm, a, b = make_pair()
+    try:
+        sink = Sink()
+
+        def bad(sender, packet):
+            raise RuntimeError("boom")
+
+        b.register("bad", bad)
+        b.register("good", sink)
+        a.send("B", {"type": "bad"})
+        a.send("B", {"type": "good"})
+        assert sink.wait_for(1)
+    finally:
+        a.close()
+        b.close()

@@ -53,6 +53,9 @@ class FailureDetection:
         self._stop = threading.Event()
         messenger.register(PING, self._on_ping)
         messenger.register(PONG, self._on_pong)
+        # any inbound frame is implicit keep-alive (heardFrom,
+        # FailureDetection.java:248) — not just pongs
+        messenger.demux.add_tap(lambda sender, _kind: self.heard_from(sender))
         for n in monitored:
             self.monitor(n)
         self._thread = threading.Thread(
@@ -77,6 +80,9 @@ class FailureDetection:
         with self._lock:
             if node in self._monitored:
                 self._monitored.remove(node)
+            # forget history so a later re-monitor gets a fresh grace window
+            self._last_heard.pop(node, None)
+            self._was_up.pop(node, None)
 
     def heard_from(self, node: str) -> None:
         """Feed from any inbound packet (wire into the demux default path)."""

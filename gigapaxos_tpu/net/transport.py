@@ -130,7 +130,7 @@ class _Peer:
                     _send_frame(self.sock, kind, payload)
                     self.t._count("sent")
                     break
-                except OSError:
+                except (OSError, struct.error):
                     try:
                         self.sock.close()
                     except OSError:
@@ -176,7 +176,6 @@ class Transport:
         self.closed = False
         self._peers: Dict[str, _Peer] = {}
         self._plock = threading.Lock()
-        self._readers: list = []
         self.stats: Dict[str, int] = {}
         self._slock = threading.Lock()
 
@@ -199,12 +198,23 @@ class Transport:
     def send_raw(self, dest: str, kind: int, payload: bytes) -> None:
         if self.closed:
             raise SendFailure("transport closed")
+        if len(payload) > MAX_FRAME:
+            # fail loudly at the sender — the receiver would drop the whole
+            # connection; big state must go through checkpoint chunking
+            raise SendFailure(
+                f"frame of {len(payload)}B exceeds MAX_FRAME={MAX_FRAME}"
+            )
         if dest == self.node_id:
             # loopback short-circuit: no socket, no serialization round-trip
             # beyond the bytes already built (keeps ordering with real sends
             # unnecessary — the reference short-circuits identically)
             self._count("loopback")
-            self.demux(self.node_id, kind, payload)
+            try:
+                self.demux(self.node_id, kind, payload)
+            except Exception:
+                # same contract as the socket read path: handler bugs are
+                # counted, not propagated into the sender
+                self._count("demux_errors")
             return
         with self._plock:
             peer = self._peers.get(dest)
@@ -227,11 +237,9 @@ class Transport:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            r = threading.Thread(
+            threading.Thread(
                 target=self._read_loop, args=(conn,), daemon=True
-            )
-            r.start()
-            self._readers.append(r)
+            ).start()
 
     def _read_loop(self, conn: socket.socket) -> None:
         sender = "?"
@@ -291,13 +299,22 @@ class JsonDemux:
 
     def __init__(self):
         self._handlers: Dict[Any, Callable[[str, dict], None]] = {}
+        self._taps: list = []  # called (sender, kind) for EVERY frame
         self.bytes_handler: Optional[Callable[[str, bytes], None]] = None
         self.default_handler: Optional[Callable[[str, dict], None]] = None
 
     def register(self, ptype, handler: Callable[[str, dict], None]) -> None:
         self._handlers[ptype] = handler
 
+    def add_tap(self, fn: Callable[[str, int], None]) -> None:
+        """Observe every inbound frame regardless of type — e.g. failure
+        detection treating any traffic as implicit keep-alive
+        (``heardFrom``, FailureDetection.java:248)."""
+        self._taps.append(fn)
+
     def __call__(self, sender: str, kind: int, payload: bytes) -> None:
+        for tap in self._taps:
+            tap(sender, kind)
         if kind == KIND_BYTES:
             if self.bytes_handler is not None:
                 self.bytes_handler(sender, payload)

@@ -84,7 +84,7 @@ class ProtocolExecutor:
         self._name = name
         self._tasks: Dict[str, ProtocolTask] = {}
         self._restarts: Dict[str, int] = {}
-        self._heap: list = []  # (deadline, seq, key)
+        self._heap: list = []  # (deadline, seq, key, task)
         self._seq = 0
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
@@ -134,12 +134,14 @@ class ProtocolExecutor:
                     return False  # completed/canceled while we waited
             msgs, done = task.handle(event)
             if done:
-                # atomic done-transition under the task lock: nobody else can
-                # observe the task as live after this point
+                # atomic done-transition under the task lock; a concurrent
+                # cancel() may have removed the task already, in which case
+                # the canceler wins and on_done must not fire
                 with self._lock:
-                    self._tasks.pop(key, None)
+                    popped = self._tasks.pop(key, None)
                     self._restarts.pop(key, None)
-                task.on_done()
+                if popped is task:
+                    task.on_done()
         self._emit(msgs)
         return True
 

@@ -1,0 +1,15 @@
+from .consistent_hashing import ConsistentHashRing
+from .coordinator import AbstractReplicaCoordinator, PaxosReplicaCoordinator
+from .demand import AbstractDemandProfile, DemandProfile, RateBasedMigrationPolicy
+from .records import RCState, ReconfigurationRecord
+
+__all__ = [
+    "ConsistentHashRing",
+    "AbstractReplicaCoordinator",
+    "PaxosReplicaCoordinator",
+    "AbstractDemandProfile",
+    "DemandProfile",
+    "RateBasedMigrationPolicy",
+    "RCState",
+    "ReconfigurationRecord",
+]

@@ -1,0 +1,191 @@
+"""The replica-coordination SPI and its Paxos binding.
+
+``AbstractReplicaCoordinator`` analog
+(``reconfiguration/AbstractReplicaCoordinator.java:51-74``): the narrow
+interface the reconfiguration layer drives —
+``coordinate_request`` / ``create_replica_group`` / ``delete_replica_group``
+plus the epoch-change helpers (stop, final-state fetch/restore).
+
+``PaxosReplicaCoordinator`` (``PaxosReplicaCoordinator.java:36``) binds the
+SPI to the dense-device ``PaxosManager``.  Epochs: the reference creates
+paxos instances keyed (name, version); the dense manager keys rows by flat
+string, so epoch e of service ``name`` lives in paxos group ``name#e``
+(``_pax_name``).  One epoch is live per name at a time; the stopped previous
+epoch's final state stays fetchable until dropped
+(``copyEpochFinalCheckpointState``, PaxosInstanceStateMachine.java:1678-1684).
+
+Node identity: the reconfiguration layer speaks string node ids; the device
+speaks replica-slot ints.  The coordinator owns that mapping (``slot_of``)
+— the IntegerMap idea (paxosutil/IntegerMap.java:40) applied to nodes.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Callable, Dict, List, Optional
+
+from ..paxos.manager import PaxosManager
+
+
+class AbstractReplicaCoordinator(abc.ABC):
+    """Subclasses choose the coordination protocol (paxos, chain, ...).
+
+    The reconfiguration layer (ActiveReplica) only ever calls these."""
+
+    @abc.abstractmethod
+    def coordinate_request(
+        self,
+        name: str,
+        epoch: int,
+        payload: bytes,
+        callback: Optional[Callable[[int, Optional[bytes]], None]] = None,
+        entry: Optional[str] = None,
+    ) -> Optional[int]:
+        """Totally order + execute one request in the name's current epoch.
+        Returns a request id or None (unknown name / wrong epoch)."""
+
+    @abc.abstractmethod
+    def create_replica_group(
+        self, name: str, epoch: int, initial_state: bytes, nodes: List[str]
+    ) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def delete_replica_group(self, name: str, epoch: int) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def get_replica_group(self, name: str) -> Optional[List[str]]:
+        ...
+
+    # ------------------------------------------------------- epoch-change SPI
+    @abc.abstractmethod
+    def stop_replica_group(
+        self, name: str, epoch: int, done: Callable[[bool], None]
+    ) -> bool:
+        """Propose the epoch-final stop; ``done(ok)`` fires when the stop
+        commits (all further proposals in the epoch are fenced)."""
+
+    @abc.abstractmethod
+    def get_final_state(self, name: str, epoch: int) -> Optional[bytes]:
+        """Checkpoint of a *stopped* epoch (None until stopped/unknown)."""
+
+    @abc.abstractmethod
+    def drop_final_state(self, name: str, epoch: int) -> bool:
+        """GC a stopped epoch's state (DropEpochFinalState)."""
+
+
+class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
+    def __init__(self, manager: PaxosManager, node_ids: List[str]):
+        """``node_ids[r]`` is the node occupying device replica slot r (the
+        sorted active set in Mode A)."""
+        self.manager = manager
+        self.node_ids = list(node_ids)
+        self._slot: Dict[str, int] = {n: i for i, n in enumerate(node_ids)}
+        self._epoch: Dict[str, int] = {}  # name -> live epoch
+
+    # ----------------------------------------------------------------- naming
+    @staticmethod
+    def _pax_name(name: str, epoch: int) -> str:
+        return f"{name}#{epoch}"
+
+    def slot_of(self, node_id: str) -> Optional[int]:
+        return self._slot.get(node_id)
+
+    def current_epoch(self, name: str) -> Optional[int]:
+        return self._epoch.get(name)
+
+    # ------------------------------------------------------------------- SPI
+    def coordinate_request(
+        self,
+        name: str,
+        epoch: int,
+        payload: bytes,
+        callback: Optional[Callable[[int, Optional[bytes]], None]] = None,
+        entry: Optional[str] = None,
+    ) -> Optional[int]:
+        if self._epoch.get(name) != epoch:
+            return None  # wrong/old epoch: client must re-resolve actives
+        slot = self._slot.get(entry) if entry is not None else None
+        return self.manager.propose(
+            self._pax_name(name, epoch), payload, callback, entry=slot
+        )
+
+    def create_replica_group(
+        self, name: str, epoch: int, initial_state: bytes, nodes: List[str]
+    ) -> bool:
+        slots = [self._slot[n] for n in nodes if n in self._slot]
+        if not slots:
+            return False
+        pname = self._pax_name(name, epoch)
+        ok = self.manager.create_paxos_instance(pname, slots, epoch)
+        if not ok:
+            return False
+        # seed app state on every member replica (StartEpoch's final-state
+        # hand-off; b"" = fresh name)
+        for s in slots:
+            self.manager.apps[s].restore(pname, initial_state)
+        live = self._epoch.get(name)
+        if live is None or epoch > live:
+            self._epoch[name] = epoch
+        return True
+
+    def delete_replica_group(self, name: str, epoch: int) -> bool:
+        pname = self._pax_name(name, epoch)
+        ok = self.manager.remove_paxos_instance(pname)
+        if self._epoch.get(name) == epoch:
+            del self._epoch[name]
+        return ok
+
+    def get_replica_group(self, name: str) -> Optional[List[str]]:
+        e = self._epoch.get(name)
+        if e is None:
+            return None
+        slots = self.manager.group_members(self._pax_name(name, e))
+        if slots is None:
+            return None
+        return [self.node_ids[s] for s in slots]
+
+    # ------------------------------------------------------- epoch-change SPI
+    def stop_replica_group(
+        self, name: str, epoch: int, done: Callable[[bool], None]
+    ) -> bool:
+        if self._epoch.get(name) != epoch:
+            # already moved on: stopping an old epoch is trivially complete
+            done(self._epoch.get(name, -1) > epoch)
+            return True
+        pname = self._pax_name(name, epoch)
+        if self.manager.is_stopped(pname):
+            done(True)
+            return True
+
+        def cb(rid: int, resp: Optional[bytes]) -> None:
+            # a stop request that fails (rid -1 / None resp) means some
+            # earlier stop won the race — the epoch is stopped either way
+            done(True)
+
+        rid = self.manager.propose_stop(pname, callback=cb)
+        return rid is not None
+
+    def get_final_state(self, name: str, epoch: int) -> Optional[bytes]:
+        pname = self._pax_name(name, epoch)
+        if not self.manager.is_stopped(pname):
+            return None
+        members = self.manager.group_members(pname)
+        if not members:
+            return None
+        # any caught-up live member's app state is the epoch-final state
+        # (the stop is the last executed request by construction)
+        for s in members:
+            if self.manager.alive[s]:
+                return self.manager.apps[s].checkpoint(pname)
+        return None
+
+    def drop_final_state(self, name: str, epoch: int) -> bool:
+        pname = self._pax_name(name, epoch)
+        members = self.manager.group_members(pname) or []
+        for s in members:
+            self.manager.apps[s].restore(pname, b"")  # free app state
+        if self.manager.rows.row(pname) is None:
+            return True
+        return self.manager.remove_paxos_instance(pname)

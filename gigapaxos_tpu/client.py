@@ -1,0 +1,253 @@
+"""Async client library.
+
+Analog of ``reconfiguration/ReconfigurableAppClientAsync.java:35`` (plus the
+paxos-only ``PaxosClientAsync.java:48``): a client endpoint that
+
+* manages names through any reconfigurator (create/delete/reconfigure,
+  retrying across RCs);
+* caches each name's active-replica set with a TTL and re-resolves on
+  ``not_active``/``stopped`` errors (the actives cache + invalidate-on-error
+  loop, ReconfigurableAppClientAsync.java:43 MIN_REQUEST_ACTIVES_INTERVAL);
+* redirects each request to the lowest-latency active by EWMA RTT with
+  occasional exploration (E2ELatencyAwareRedirector.java:18 +
+  RTTEstimator.java:28);
+* correlates responses by request id, with both sync helpers and async
+  callbacks (RequestCallbackFuture analog).
+
+The client binds its own ephemeral port and stamps ``client_addr`` on every
+packet so servers can address it back over the node transport.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import random
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .config import NodeConfig
+from .net.messenger import Messenger, NodeMap
+from .reconfiguration import packets as pkt
+
+
+class ClientError(Exception):
+    pass
+
+
+class ReconfigurableAppClient:
+    def __init__(
+        self,
+        nodes: NodeConfig,
+        client_id: Optional[str] = None,
+        bind_host: str = "127.0.0.1",
+        actives_ttl_s: float = 30.0,
+        explore_prob: float = 0.1,
+    ):
+        self.node_id = client_id or f"C{uuid.uuid4().hex[:8]}"
+        self.nodemap = NodeMap(nodes)
+        self.m = Messenger(self.node_id, (bind_host, 0), self.nodemap)
+        self.addr = (bind_host, self.m.port)
+        self.rc_ids = list(nodes.reconfigurator_ids())
+        if not self.rc_ids:
+            raise ClientError("no reconfigurators in topology")
+        self._rc_rr = itertools.cycle(self.rc_ids)
+        self.actives_ttl_s = actives_ttl_s
+        self.explore_prob = explore_prob
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._next_rid = random.randrange(1, 1 << 30)
+        # bounded: late responses to abandoned rids and callbacks for
+        # requests that never get answered must not accumulate forever
+        # (the reference GC's its callback maps the same way,
+        # GCConcurrentHashMap in ReconfigurableAppClientAsync)
+        self._results: "collections.OrderedDict[int, dict]" = collections.OrderedDict()
+        self._results_cap = 2048
+        self._callbacks: Dict[int, Callable[[dict], None]] = {}
+        self._cb_deadline: Dict[int, float] = {}
+        self._cb_ttl_s = 120.0
+        #: name -> (expiry_monotonic, actives list)
+        self._actives: Dict[str, Tuple[float, List[str]]] = {}
+        self._rtt: Dict[str, float] = {}  # active id -> EWMA seconds
+        self._sent_at: Dict[int, Tuple[str, float]] = {}
+        for t in (pkt.CREATE_RESPONSE, pkt.DELETE_RESPONSE,
+                  pkt.ACTIVES_RESPONSE, pkt.RECONFIGURE_RESPONSE,
+                  pkt.APP_RESPONSE, pkt.ECHO_REPLY):
+            self.m.register(t, self._on_response)
+
+    def close(self) -> None:
+        self.m.close()
+
+    # ------------------------------------------------------------- plumbing
+    def _rid(self) -> int:
+        with self._lock:
+            self._next_rid += 1
+            return self._next_rid
+
+    def _stamp(self, p: dict) -> dict:
+        p["client_addr"] = [self.addr[0], self.addr[1]]
+        return p
+
+    def _on_response(self, sender: str, p: dict) -> None:
+        rid = p.get("rid")
+        cb = None
+        with self._lock:
+            if rid is not None:
+                sa = self._sent_at.pop(rid, None)
+                if sa is not None:
+                    node, t0 = sa
+                    rtt = time.monotonic() - t0
+                    prev = self._rtt.get(node)
+                    self._rtt[node] = rtt if prev is None else 0.875 * prev + 0.125 * rtt
+                cb = self._callbacks.pop(rid, None)
+                self._cb_deadline.pop(rid, None)
+                if cb is None:
+                    self._results[rid] = p
+                    while len(self._results) > self._results_cap:
+                        self._results.popitem(last=False)
+                    self._cv.notify_all()
+        if cb is not None:
+            cb(p)
+
+    def _await(self, rid: int, timeout: float) -> dict:
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while rid not in self._results:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    self._sent_at.pop(rid, None)
+                    raise TimeoutError(f"rid {rid}")
+                self._cv.wait(timeout=left)
+            return self._results.pop(rid)
+
+    def _rpc_rc(self, packet: dict, timeout: float, tries: int = 3) -> dict:
+        """Send a control request to reconfigurators, rotating on timeout."""
+        last: Optional[Exception] = None
+        per = max(timeout / tries, 0.5)
+        for _ in range(tries):
+            rc = next(self._rc_rr)
+            p = dict(packet)
+            p["rid"] = self._rid()
+            try:
+                self.m.send(rc, self._stamp(p))
+                return self._await(p["rid"], per)
+            except TimeoutError as e:
+                last = e
+        raise TimeoutError(str(last))
+
+    # ------------------------------------------------------- name management
+    def create(self, name: str, initial_state: bytes = b"",
+               timeout: float = 15.0) -> dict:
+        return self._rpc_rc(
+            pkt.create_service_name(name, initial_state, 0), timeout
+        )
+
+    def delete(self, name: str, timeout: float = 15.0) -> dict:
+        resp = self._rpc_rc(pkt.delete_service_name(name, 0), timeout)
+        with self._lock:
+            self._actives.pop(name, None)
+        return resp
+
+    def reconfigure(self, name: str, new_actives: List[str],
+                    timeout: float = 20.0) -> dict:
+        resp = self._rpc_rc(pkt.client_reconfigure(name, new_actives, 0), timeout)
+        with self._lock:
+            self._actives.pop(name, None)
+        return resp
+
+    def request_actives(self, name: str, timeout: float = 10.0,
+                        force: bool = False) -> List[str]:
+        with self._lock:
+            hit = self._actives.get(name)
+            if hit is not None and not force and hit[0] > time.monotonic():
+                return list(hit[1])
+        resp = self._rpc_rc(pkt.request_active_replicas(name, 0), timeout)
+        if not resp.get("ok"):
+            raise ClientError(resp.get("error", "unknown_name"))
+        actives = resp["actives"]
+        for a, addr in resp.get("addrs", {}).items():
+            if self.nodemap(a) is None:
+                self.nodemap.add(a, addr[0], int(addr[1]))
+        with self._lock:
+            self._actives[name] = (time.monotonic() + self.actives_ttl_s, actives)
+        return list(actives)
+
+    # ----------------------------------------------------------- app requests
+    def _pick_active(self, actives: List[str]) -> str:
+        """Lowest-EWMA-RTT active, with epsilon exploration so a recovered
+        replica gets re-measured (E2ELatencyAwareRedirector's probe idea)."""
+        unknown = [a for a in actives if a not in self._rtt]
+        if unknown or random.random() < self.explore_prob:
+            return random.choice(unknown or actives)
+        return min(actives, key=lambda a: self._rtt.get(a, float("inf")))
+
+    def send_request(
+        self,
+        name: str,
+        payload: bytes,
+        callback: Callable[[dict], None],
+        active: Optional[str] = None,
+    ) -> int:
+        """Fire one app request; the callback gets the raw response packet
+        (``ok``/``response``/``error``).  Actives must be resolvable."""
+        target = active or self._pick_active(self.request_actives(name))
+        rid = self._rid()
+        now = time.monotonic()
+        with self._lock:
+            if len(self._callbacks) > 4096:
+                dead = [r for r, d in self._cb_deadline.items() if d < now]
+                for r in dead:
+                    self._callbacks.pop(r, None)
+                    self._cb_deadline.pop(r, None)
+                    self._sent_at.pop(r, None)
+            self._callbacks[rid] = callback
+            self._cb_deadline[rid] = now + self._cb_ttl_s
+            self._sent_at[rid] = (target, now)
+        self.m.send(target, self._stamp(pkt.app_request(name, payload, rid)))
+        return rid
+
+    def request(self, name: str, payload: bytes, timeout: float = 15.0,
+                tries: int = 4) -> bytes:
+        """Sync request with redirection: on not_active/stopped, invalidate
+        the cache, re-resolve and retry (the client's reconfiguration-chase
+        loop)."""
+        per = max(timeout / tries, 0.5)
+        last = "timeout"
+        for attempt in range(tries):
+            try:
+                actives = self.request_actives(name, force=attempt > 0)
+            except ClientError as e:
+                raise ClientError(f"{name}: {e}") from e
+            target = self._pick_active(actives)
+            rid = self._rid()
+            with self._lock:
+                self._sent_at[rid] = (target, time.monotonic())
+            self.m.send(target, self._stamp(pkt.app_request(name, payload, rid)))
+            try:
+                resp = self._await(rid, per)
+            except TimeoutError:
+                last = f"timeout via {target}"
+                continue
+            if resp.get("ok"):
+                return pkt.b64d(resp["response"]) or b""
+            last = resp.get("error", "error")
+            if last not in ("not_active", "stopped"):
+                raise ClientError(f"{name}: {last}")
+            time.sleep(min(0.1 * (attempt + 1), 0.5))
+        raise TimeoutError(f"{name}: {last}")
+
+    # ------------------------------------------------------------------ echo
+    def echo(self, active: str, timeout: float = 5.0) -> float:
+        """RTT-probe one active (handleEchoRequest analog); returns seconds."""
+        rid = self._rid()
+        t0 = time.monotonic()
+        self.m.send(active, self._stamp({
+            "type": pkt.ECHO_REQUEST, "ts": t0, "rid": rid,
+        }))
+        self._await(rid, timeout)
+        rtt = time.monotonic() - t0
+        prev = self._rtt.get(active)
+        self._rtt[active] = rtt if prev is None else 0.875 * prev + 0.125 * rtt
+        return rtt

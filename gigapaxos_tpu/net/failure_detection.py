@@ -55,7 +55,8 @@ class FailureDetection:
         messenger.register(PONG, self._on_pong)
         # any inbound frame is implicit keep-alive (heardFrom,
         # FailureDetection.java:248) — not just pongs
-        messenger.demux.add_tap(lambda sender, _kind: self.heard_from(sender))
+        self._tap = lambda sender, _kind: self.heard_from(sender)
+        messenger.demux.add_tap(self._tap)
         for n in monitored:
             self.monitor(n)
         self._thread = threading.Thread(
@@ -85,10 +86,15 @@ class FailureDetection:
             self._was_up.pop(node, None)
 
     def heard_from(self, node: str) -> None:
-        """Feed from any inbound packet (wire into the demux default path)."""
+        """Feed from any inbound packet (wire into the demux default path).
+
+        Only monitored peers are tracked — the tap sees every inbound frame,
+        including ones from ephemeral client ids, which must not accrete
+        state here."""
         now = time.monotonic()
         with self._lock:
-            self._last_heard[node] = now
+            if node in self._last_heard:
+                self._last_heard[node] = now
 
     def is_node_up(self, node: str) -> bool:
         """``isNodeUp`` (FailureDetection.java:252-258); self is always up."""
@@ -105,6 +111,9 @@ class FailureDetection:
     def close(self) -> None:
         self._stop.set()
         self._thread.join(timeout=2)
+        # detach from the shared messenger so a closed detector stops being
+        # invoked (and mutating state) on later frames
+        self.m.demux.remove_tap(self._tap)
 
     # ---------------------------------------------------------------- private
     def _on_ping(self, sender: str, packet: dict) -> None:

@@ -312,6 +312,12 @@ class JsonDemux:
         (``heardFrom``, FailureDetection.java:248)."""
         self._taps.append(fn)
 
+    def remove_tap(self, fn: Callable[[str, int], None]) -> None:
+        try:
+            self._taps.remove(fn)
+        except ValueError:
+            pass
+
     def __call__(self, sender: str, kind: int, payload: bytes) -> None:
         for tap in self._taps:
             tap(sender, kind)

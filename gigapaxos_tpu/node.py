@@ -1,0 +1,166 @@
+"""Node bootstrap: wire transport, data plane and control plane together.
+
+Analog of ``reconfiguration/ReconfigurableNode.java:63`` (entry point that
+builds a messenger, then an ActiveReplica and/or Reconfigurator per role)
+plus ``TESTReconfigurationMain.startLocalServers``
+(reconfiguration/testing/TESTReconfigurationMain.java:86), whose strategy —
+instantiate every node of a cluster *in one process* on loopback ports with
+real sockets — is exactly how our tests run (SURVEY §4).
+
+TPU shape (Mode A): all active replicas of one deployment share a single
+dense-device data plane — node ids are replica slots of one mesh program —
+so the cluster owns
+
+* one active-side :class:`PaxosManager` (R = #actives) + TickDriver,
+* one RC-side :class:`PaxosManager` (R = #reconfigurators) + TickDriver,
+  whose apps are the :class:`ReconfiguratorDB` replicas,
+* per active node id: a Messenger + :class:`ActiveReplica`,
+* per RC node id: a Messenger + :class:`Reconfigurator`,
+* failure detectors on every node feeding a shared liveness view.
+
+In a multi-host deployment the same wiring runs once per host with the
+replica axis sharded over the mesh (parallel/mesh.py); the control-plane
+objects are unchanged — only the manager's mesh placement differs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from .config import GigapaxosTpuConfig
+from .models.replicable import Replicable
+from .net.failure_detection import FailureDetection
+from .net.messenger import Messenger, NodeMap
+from .paxos.driver import TickDriver
+from .paxos.manager import PaxosManager
+from .reconfiguration.active_replica import ActiveReplica
+from .reconfiguration.coordinator import PaxosReplicaCoordinator
+from .reconfiguration.demand import AbstractDemandProfile, DemandProfile
+from .reconfiguration.rc_db import (
+    ReconfiguratorDB,
+    RepliconfigurableReconfiguratorDB,
+)
+from .reconfiguration.reconfigurator import Reconfigurator
+
+
+class InProcessCluster:
+    """A whole deployment in one process on loopback ports.
+
+    ``cfg.nodes`` lists actives and reconfigurators with their bind
+    addresses (the ``active.*``/``reconfigurator.*`` topology of
+    ``gigapaxos.properties``); ``app_factory()`` builds one Replicable per
+    active replica slot.
+    """
+
+    def __init__(
+        self,
+        cfg: GigapaxosTpuConfig,
+        app_factory: Callable[[], Replicable],
+        demand_profile_factory: Callable[[str], AbstractDemandProfile] = DemandProfile,
+        replicas_per_name: int = 3,
+        rc_group_size: int = 3,
+        wal=None,
+        rc_wal=None,
+        start_fd: bool = False,
+    ):
+        self.cfg = cfg
+        active_ids = cfg.nodes.active_ids()
+        rc_ids = cfg.nodes.reconfigurator_ids()
+        if not active_ids or not rc_ids:
+            raise ValueError("topology needs >=1 active and >=1 reconfigurator")
+
+        # ---------------- data plane (shared dense device state, Mode A)
+        self.manager = PaxosManager(
+            cfg, len(active_ids), [app_factory() for _ in active_ids], wal=wal
+        )
+        self.coordinator = PaxosReplicaCoordinator(self.manager, active_ids)
+        self.driver = TickDriver(self.manager).start()
+
+        # ---------------- RC plane (the DB replicated on its own data plane)
+        self.rc_manager = PaxosManager(
+            cfg, len(rc_ids), [ReconfiguratorDB(r) for r in rc_ids], wal=rc_wal
+        )
+        self.rdb = RepliconfigurableReconfiguratorDB(
+            self.rc_manager, rc_ids, k=rc_group_size
+        )
+        self.rc_driver = TickDriver(self.rc_manager).start()
+
+        # ---------------- per-node control plane endpoints
+        self.nodemap = NodeMap(cfg.nodes)
+        self.actives: Dict[str, ActiveReplica] = {}
+        self.reconfigurators: Dict[str, Reconfigurator] = {}
+        self.fds: Dict[str, FailureDetection] = {}
+        self._liveness: Dict[str, bool] = {n: True for n in rc_ids + active_ids}
+
+        for a in active_ids:
+            m = Messenger(a, cfg.nodes.actives[a], self.nodemap)
+            # port 0 binds ephemerally: publish the real port, both in this
+            # cluster's nodemap and back into cfg.nodes so clients built
+            # from the same config resolve correctly
+            self.nodemap.add(a, cfg.nodes.actives[a][0], m.port)
+            cfg.nodes.actives[a] = (cfg.nodes.actives[a][0], m.port)
+            self.actives[a] = ActiveReplica(
+                a, m, self.coordinator, rc_ids,
+                demand_profile_factory=demand_profile_factory,
+                rc_group_size=rc_group_size,
+            )
+        for r in rc_ids:
+            m = Messenger(r, cfg.nodes.reconfigurators[r], self.nodemap)
+            self.nodemap.add(r, cfg.nodes.reconfigurators[r][0], m.port)
+            cfg.nodes.reconfigurators[r] = (cfg.nodes.reconfigurators[r][0], m.port)
+            self.reconfigurators[r] = Reconfigurator(
+                r, m, self.rdb, active_ids,
+                replicas_per_name=replicas_per_name,
+                demand_profile_factory=demand_profile_factory,
+                is_node_up=lambda n: self._liveness.get(n, True),
+            )
+        # block until both planes' jitted ticks are compiled — otherwise the
+        # first client RPC races a multi-second XLA compile and times out
+        self.driver.wait_ready()
+        self.rc_driver.wait_ready()
+        if start_fd:
+            for r in rc_ids:
+                self.fds[r] = FailureDetection(
+                    self.reconfigurators[r].m, monitored=rc_ids,
+                    ping_interval_s=cfg.fd.ping_interval_s,
+                    timeout_s=cfg.fd.timeout_s,
+                    on_change=self._fd_change,
+                )
+
+    def _fd_change(self, node: str, up: bool) -> None:
+        self._liveness[node] = up
+
+    # ----------------------------------------------------------------- admin
+    def kick(self) -> None:
+        self.driver.kick()
+        self.rc_driver.kick()
+
+    def set_node_up(self, node: str, up: bool) -> None:
+        """Test hook: mark a node's liveness (crash emulation, the analog of
+        TESTPaxosConfig.crash, testing/TESTPaxosConfig.java:563-578)."""
+        self._liveness[node] = up
+
+    def close(self) -> None:
+        for fd in self.fds.values():
+            fd.close()
+        for ar in self.actives.values():
+            ar.close()
+        for rc in self.reconfigurators.values():
+            rc.close()
+        self.driver.stop()
+        self.rc_driver.stop()
+
+
+def build_node(
+    node_id: str,
+    cfg: GigapaxosTpuConfig,
+    app_factory: Callable[[], Replicable],
+    **kw,
+) -> InProcessCluster:
+    """CLI-style single-entry bootstrap (ReconfigurableNode.main analog).
+
+    Today every deployment is driven by one process per replica-mesh (Mode
+    A), so this simply builds the cluster object; per-host Mode B spawning
+    lands with the multi-host transport binding.
+    """
+    return InProcessCluster(cfg, app_factory, **kw)

@@ -1,0 +1,77 @@
+"""TickDriver: the thread that pumps the device data plane.
+
+The reference's data plane is driven by packet arrival (NIO threads call
+``PaxosManager.handleIncomingPacket``); the dense design instead advances
+*all* groups in one fused device step, so something must call
+``manager.tick()`` repeatedly.  This driver is that something: it ticks
+eagerly while work is pending (queued proposals, undelivered windows) and
+backs off to a low idle rate otherwise — the RequestBatcher's adaptive-sleep
+idea (``gigapaxos/RequestBatcher.java:25-60``) applied to the whole plane.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .manager import PaxosManager
+
+
+class TickDriver:
+    def __init__(
+        self,
+        manager: PaxosManager,
+        idle_sleep_s: float = 0.002,
+        drain_ticks: int = 4,
+    ):
+        """``drain_ticks``: extra ticks after the queues empty so in-flight
+        device state (accepted-but-undecided slots, ring-buffer deliveries)
+        reaches quiescence before the driver goes idle."""
+        self.manager = manager
+        self.idle_sleep_s = idle_sleep_s
+        self.drain_ticks = drain_ticks
+        self._stop = threading.Event()
+        self._kick = threading.Event()
+        self._first_tick = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="tick-driver", daemon=True
+        )
+
+    def start(self) -> "TickDriver":
+        self._thread.start()
+        return self
+
+    def kick(self) -> None:
+        """Wake the driver immediately (call after enqueuing proposals)."""
+        self._kick.set()
+
+    def wait_ready(self, timeout_s: float = 120.0) -> bool:
+        """Block until the first tick completed — i.e. the jitted step is
+        compiled and the plane answers at interactive latency."""
+        return self._first_tick.wait(timeout=timeout_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._kick.set()
+        self._thread.join(timeout=10)
+
+    def _run(self) -> None:
+        drain = self.drain_ticks
+        while not self._stop.is_set():
+            out = self.manager.tick()
+            self._first_tick.set()
+            # CPython locks are unfair: without a real sleep here the driver
+            # re-acquires manager.lock before any waiting control-plane
+            # thread (propose, create, stop) gets scheduled, starving them
+            # indefinitely.  This yield window is the fairness mechanism.
+            time.sleep(0.0005)
+            busy = self.manager.pending_count() > 0
+            if not busy:
+                # decided_now needs a device sync; only check when draining
+                drain -= 1
+                if drain <= 0:
+                    self._kick.wait(timeout=self.idle_sleep_s)
+                    self._kick.clear()
+                    drain = 1  # idle wake: one probe tick, drain more if busy
+            else:
+                drain = self.drain_ticks

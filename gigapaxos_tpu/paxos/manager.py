@@ -25,6 +25,8 @@ axis exchange goes over the transport instead (net/, Mode B).
 from __future__ import annotations
 
 import collections
+import functools
+import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -37,6 +39,18 @@ from ..types import GroupStatus, NO_REQUEST
 from ..utils.intmap import RowAllocator
 from . import state as st
 from ..ops.tick import TickInbox, TickOutbox, paxos_tick
+
+
+def _locked(fn):
+    """Serialize a public PaxosManager method on ``self.lock`` (reentrant, so
+    callbacks that re-enter propose from the tick thread are fine)."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *a, **kw):
+        with self.lock:
+            return fn(self, *a, **kw)
+
+    return wrapper
 
 
 @dataclass
@@ -89,10 +103,16 @@ class PaxosManager:
         self._seen_cap = 8 * self.W
         self.stats = collections.Counter()
         self._stopped_rows: set[int] = set()
+        # Control-plane threads (messenger readers, protocol tasks) call the
+        # admin/propose API while a tick driver loops on tick(); one reentrant
+        # lock serializes them (the reference synchronizes on the instance map
+        # the same way, PaxosManager.java:2284-2412).
+        self.lock = threading.RLock()
         if self.wal is not None:
             self.wal.attach(self)
 
     # ------------------------------------------------------------------ admin
+    @_locked
     def create_paxos_instance(
         self, name: str, members: List[int], epoch: int = 0
     ) -> bool:
@@ -114,6 +134,7 @@ class PaxosManager:
             self.wal.log_create(name, members, epoch)
         return True
 
+    @_locked
     def remove_paxos_instance(self, name: str) -> bool:
         """kill/cremation analog (PaxosManager.java:2162-2205)."""
         row = self.rows.row(name)
@@ -127,17 +148,20 @@ class PaxosManager:
             self.wal.log_remove(name)
         return True
 
+    @_locked
     def group_members(self, name: str) -> Optional[List[int]]:
         row = self.rows.row(name)
         if row is None:
             return None
         return [int(r) for r in np.where(np.array(self.state.member[:, row]))[0]]
 
+    @_locked
     def is_stopped(self, name: str) -> bool:
         row = self.rows.row(name)
         return row is not None and row in self._stopped_rows
 
     # ---------------------------------------------------------------- propose
+    @_locked
     def propose(
         self,
         name: str,
@@ -225,6 +249,7 @@ class PaxosManager:
             jnp.asarray(req), jnp.asarray(stp), jnp.asarray(self.alive.copy())
         )
 
+    @_locked
     def tick(self) -> TickOutbox:
         inbox = self._build_inbox()
         if self.wal is not None:
@@ -330,6 +355,7 @@ class PaxosManager:
     def set_alive(self, r: int, up: bool) -> None:
         self.alive[r] = up
 
+    @_locked
     def sync_laggard(self, r: int, name: str) -> bool:
         """Checkpoint transfer for a replica lagging >= W on a group
         (StatePacket/handleCheckpoint analog,
@@ -359,6 +385,7 @@ class PaxosManager:
         self.stats["checkpoint_transfers"] += 1
         return True
 
+    @_locked
     def auto_sync_laggards(self, out: TickOutbox) -> int:
         """Scan the lag signal and run checkpoint transfers where ring sync
         cannot catch up (lag >= W)."""
@@ -377,5 +404,6 @@ class PaxosManager:
         for _ in range(n):
             self.tick()
 
+    @_locked
     def pending_count(self) -> int:
         return sum(len(q) for q in self._queues.values())

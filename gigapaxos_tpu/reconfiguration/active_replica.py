@@ -1,0 +1,228 @@
+"""ActiveReplica: the data-plane node's control-plane face.
+
+Analog of ``reconfiguration/ActiveReplica.java:131``: wraps a replica
+coordinator and executes the epoch lifecycle ops sent by reconfigurators —
+
+* ``handleStartEpoch`` (:891)  → :meth:`_on_start_epoch` (create the new
+  epoch's group, fetching the previous epoch's final state if needed via
+  ``WaitEpochFinalState``, reconfigurationprotocoltasks/WaitEpochFinalState.java:47);
+* ``handleStopEpoch`` (:1012) → :meth:`_on_stop_epoch` (propose the epoch
+  stop through the coordinator, ack when the fence commits);
+* ``handleDropEpochFinalState`` (:1063) → :meth:`_on_drop_epoch`;
+* ``handleRequestEpochFinalState`` (:1179) → :meth:`_on_request_final_state`;
+* ``handleEchoRequest`` (:1126) → :meth:`_on_echo`;
+
+plus the client-facing app-request path (coordinate + respond) and
+demand reporting (``DemandReport`` sends to the name's RC group, §3.4).
+
+TPU shape: many ActiveReplica objects (one per active node id) share one
+dense-device coordinator in-process — the node ids are replica *slots* of
+one mesh program, so "create group on 3 actives" is one row insertion with a
+3-bit member mask, and a StartEpoch raced by several ARs is naturally
+idempotent.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..net.messenger import Messenger
+from ..protocoltask.executor import ProtocolExecutor, ProtocolTask
+from . import packets as pkt
+from .consistent_hashing import ConsistentHashRing
+from .coordinator import AbstractReplicaCoordinator
+from .demand import AbstractDemandProfile, DemandProfile
+
+
+class WaitEpochFinalState(ProtocolTask):
+    """Fetch a stopped epoch's final state from its previous actives, then
+    create the new epoch's group (WaitEpochFinalState.java:47)."""
+
+    period_s = 0.5
+    max_restarts = 40
+
+    def __init__(self, ar: "ActiveReplica", packet: dict):
+        self.ar = ar
+        self.p = packet
+        self._i = 0
+
+    @property
+    def key(self) -> str:
+        return f"WaitEpochFinalState:{self.p['name']}:{self.p['epoch']}"
+
+    def start(self):
+        name, prev = self.p["name"], self.p["prev_epoch"]
+        targets = [a for a in self.p["prev_actives"] if a != self.ar.node_id]
+        if not targets:
+            return []
+        # round-robin over previous actives until one has the state
+        dest = targets[self._i % len(targets)]
+        self._i += 1
+        return [(dest, pkt.request_epoch_final_state(name, prev, self.ar.node_id))]
+
+    def handle(self, event: dict):
+        if not event.get("found"):
+            return [], False
+        state = pkt.b64d(event.get("state")) or b""
+        self.ar._create_started_epoch(self.p, state)
+        return [], True
+
+
+class ActiveReplica:
+    def __init__(
+        self,
+        node_id: str,
+        messenger: Messenger,
+        coordinator: AbstractReplicaCoordinator,
+        rc_ids: List[str],
+        demand_profile_factory: Callable[[str], AbstractDemandProfile] = DemandProfile,
+        rc_group_size: int = 3,
+    ):
+        self.node_id = node_id
+        self.m = messenger
+        self.coord = coordinator
+        self.rc_ring = ConsistentHashRing(rc_ids)
+        self.rc_k = min(rc_group_size, max(1, len(rc_ids)))
+        self.profile_factory = demand_profile_factory
+        self._profiles: Dict[str, AbstractDemandProfile] = {}
+        self._plock = threading.Lock()
+        self.executor = ProtocolExecutor(self.m.send, name=f"ar-{node_id}")
+        for ptype, h in [
+            (pkt.APP_REQUEST, self._on_app_request),
+            (pkt.STOP_EPOCH, self._on_stop_epoch),
+            (pkt.START_EPOCH, self._on_start_epoch),
+            (pkt.DROP_EPOCH, self._on_drop_epoch),
+            (pkt.REQUEST_EPOCH_FINAL_STATE, self._on_request_final_state),
+            (pkt.EPOCH_FINAL_STATE, self._on_epoch_final_state),
+            (pkt.ECHO_REQUEST, self._on_echo),
+        ]:
+            self.m.register(ptype, h)
+
+    def close(self) -> None:
+        self.executor.stop()
+        self.m.close()
+
+    # ------------------------------------------------------------ app requests
+    def _on_app_request(self, sender: str, p: dict) -> None:
+        pkt.register_client(self.m.nodemap, p)
+        name, rid = p["name"], p["rid"]
+        epoch = self.coord.current_epoch(name)
+        if epoch is None:
+            self.m.send(sender, {
+                "type": pkt.APP_RESPONSE, "rid": rid, "ok": False,
+                "error": "not_active", "name": name,
+            })
+            return
+        self._register_demand(name, sender, epoch)
+        need = p.get("need_response", True)
+
+        def cb(req_id: int, resp: Optional[bytes]) -> None:
+            if not need:
+                return
+            if req_id < 0 or resp is None:
+                # epoch stopped underneath us: client must re-resolve actives
+                self.m.send(sender, {
+                    "type": pkt.APP_RESPONSE, "rid": rid, "ok": False,
+                    "error": "stopped", "name": name,
+                })
+            else:
+                self.m.send(sender, {
+                    "type": pkt.APP_RESPONSE, "rid": rid, "ok": True,
+                    "name": name, "response": pkt.b64e(resp),
+                })
+
+        r = self.coord.coordinate_request(
+            name, epoch, pkt.b64d(p["payload"]) or b"", cb, entry=self.node_id
+        )
+        if r is None and need:
+            self.m.send(sender, {
+                "type": pkt.APP_RESPONSE, "rid": rid, "ok": False,
+                "error": "not_active", "name": name,
+            })
+
+    def _register_demand(self, name: str, sender: str, epoch: int) -> None:
+        with self._plock:
+            prof = self._profiles.get(name)
+            if prof is None:
+                prof = self._profiles[name] = self.profile_factory(name)
+            prof.register_request(sender)
+            stats = prof.get_stats() if prof.should_report() else None
+        if stats is not None:
+            # ship to the name's RC group (handleDemandReport aggregates and
+            # decides; sending to all k members tolerates RC failures)
+            for rc in self.rc_ring.replicated_servers(name, self.rc_k):
+                self.m.send(rc, pkt.demand_report(name, epoch, stats, self.node_id))
+
+    # ---------------------------------------------------------- epoch lifecycle
+    def _on_stop_epoch(self, sender: str, p: dict) -> None:
+        name, epoch, initiator = p["name"], p["epoch"], p["initiator"]
+        ack = {"type": pkt.ACK_STOP_EPOCH, "name": name, "epoch": epoch}
+        cur = self.coord.current_epoch(name)
+        if cur is None or cur > epoch:
+            # unknown or already moved on — the stop is moot (idempotent ack)
+            self.m.send(initiator, ack)
+            return
+
+        def done(ok: bool) -> None:
+            self.m.send(initiator, ack)
+
+        started = self.coord.stop_replica_group(name, epoch, done)
+        if not started:
+            self.m.send(initiator, ack)
+
+    def _on_start_epoch(self, sender: str, p: dict) -> None:
+        name, epoch = p["name"], p["epoch"]
+        cur = self.coord.current_epoch(name)
+        if cur is not None and cur >= epoch:
+            self._ack_start(p)  # duplicate/raced StartEpoch
+            return
+        if p["prev_epoch"] < 0:
+            # creation: seed with the client-provided initial state
+            self._create_started_epoch(p, pkt.b64d(p["initial_state"]) or b"")
+            return
+        # migration: the previous epoch's final state may be local (shared
+        # dense coordinator) or remote (fetch task)
+        state = self.coord.get_final_state(name, p["prev_epoch"])
+        if state is not None:
+            self._create_started_epoch(p, state)
+        else:
+            self.executor.schedule(WaitEpochFinalState(self, p))
+
+    def _create_started_epoch(self, p: dict, state: bytes) -> None:
+        self.coord.create_replica_group(p["name"], p["epoch"], state, p["actives"])
+        self._ack_start(p)
+
+    def _ack_start(self, p: dict) -> None:
+        self.m.send(p["initiator"], {
+            "type": pkt.ACK_START_EPOCH, "name": p["name"], "epoch": p["epoch"],
+        })
+
+    def _on_drop_epoch(self, sender: str, p: dict) -> None:
+        name, epoch = p["name"], p["epoch"]
+        self.coord.drop_final_state(name, epoch)
+        # drop the demand profile too: if the name migrated away or died,
+        # the profile must not linger (it is recreated on the next request)
+        with self._plock:
+            self._profiles.pop(name, None)
+        self.m.send(p["initiator"], {
+            "type": pkt.ACK_DROP_EPOCH, "name": name, "epoch": epoch,
+        })
+
+    def _on_request_final_state(self, sender: str, p: dict) -> None:
+        state = self.coord.get_final_state(p["name"], p["epoch"])
+        self.m.send(p["requester"], pkt.epoch_final_state(p["name"], p["epoch"], state))
+
+    def _on_epoch_final_state(self, sender: str, p: dict) -> None:
+        self.executor.handle_event(
+            f"WaitEpochFinalState:{p['name']}:{p['epoch'] + 1}", p
+        )
+
+    # ------------------------------------------------------------------- echo
+    def _on_echo(self, sender: str, p: dict) -> None:
+        pkt.register_client(self.m.nodemap, p)
+        self.m.send(sender, {
+            "type": pkt.ECHO_REPLY, "ts": p.get("ts", time.time()),
+            "rid": p.get("rid"), "node": self.node_id,
+        })

@@ -186,6 +186,10 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
         members = self.manager.group_members(pname) or []
         for s in members:
             self.manager.apps[s].restore(pname, b"")  # free app state
+        # dropping the live epoch (name deletion) must clear the epoch map,
+        # or a later re-creation at epoch 0 looks like a duplicate StartEpoch
+        if self._epoch.get(name) == epoch:
+            del self._epoch[name]
         if self.manager.rows.row(pname) is None:
             return True
         return self.manager.remove_paxos_instance(pname)

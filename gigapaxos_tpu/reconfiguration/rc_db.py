@@ -1,0 +1,225 @@
+"""The reconfigurator database — itself replicated on the data plane.
+
+Reference analogs:
+
+* ``AbstractReconfiguratorDB.java:77`` — application semantics over
+  per-name :class:`ReconfigurationRecord`s, driven by deterministic
+  RCRecordRequest commands;
+* ``RepliconfigurableReconfiguratorDB.java:54`` — wraps that DB in a
+  ``PaxosReplicaCoordinator`` so reconfigurator state is itself
+  paxos-replicated ("the control plane runs *on* the data plane",
+  SURVEY §3.4);
+* ``SQLReconfiguratorDB.java:93`` — durability, which here falls out of the
+  data plane's own WAL (commands are replayed into the DB app on recovery).
+
+Design: :class:`ReconfiguratorDB` is a :class:`Replicable` whose requests
+are JSON commands (create / delete_intent / delete_complete /
+reconfigure_intent / reconfigure_complete).  One DB replica lives on each
+reconfigurator node; commands commit through the RC nodes' own
+:class:`PaxosManager`, one paxos group per consistent-hash RC group —
+exactly the reference's RC group scheme (``ConsistentHashing.java:40-64``),
+so a name's record is replicated on the k reconfigurators that own it.
+
+Each DB replica invokes ``listener(command, record_dict)`` after applying a
+command, which is how a Reconfigurator learns about commits it did not
+propose (the basis of primary-failover, WaitPrimaryExecution.java:60).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..models.replicable import Replicable
+from ..paxos.manager import PaxosManager
+from .consistent_hashing import ConsistentHashRing
+from .records import RCState, ReconfigurationRecord
+
+#: paxos-group-name prefix for RC-group instances
+RC_GROUP_PREFIX = "_RC:"
+#: the special node-config record/group replicated on ALL reconfigurators
+#: (the reference's AbstractReconfiguratorDB.RecordNames.RC_NODES)
+NC_RECORD = "_NC"
+
+
+class ReconfiguratorDB(Replicable):
+    """One reconfigurator node's replica of the record database.
+
+    ``execute`` is deterministic over (records, command) — every replica of
+    an RC group derives identical records from the committed command stream.
+    Non-state inputs (wall time for delete aging) ride inside the command.
+    """
+
+    def __init__(self, node_id: str = "?"):
+        self.node_id = node_id
+        self.records: Dict[str, ReconfigurationRecord] = {}
+        self._lock = threading.RLock()
+        #: called (command_dict, record_dict_or_none) after each apply
+        self.listener: Optional[Callable[[dict, Optional[dict]], None]] = None
+        #: scope(service_name, paxos_group_name) -> bool; installed by
+        #: RepliconfigurableReconfiguratorDB so checkpoint/restore of one RC
+        #: paxos group only touches the records that group owns (a node in
+        #: several RC groups must not clobber one group's records with a
+        #: checkpoint of another's)
+        self.scope: Optional[Callable[[str, str], bool]] = None
+
+    # ----------------------------------------------------------- inspection
+    def get(self, name: str) -> Optional[ReconfigurationRecord]:
+        with self._lock:
+            return self.records.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(n for n in self.records if n != NC_RECORD)
+
+    # ------------------------------------------------------------ Replicable
+    def execute(self, name: str, request: bytes, request_id: int) -> bytes:
+        cmd = json.loads(request.decode())
+        with self._lock:
+            result = self._apply(cmd)
+        if self.listener is not None:
+            rec = self.get(cmd.get("name", ""))
+            self.listener(cmd, rec.to_dict() if rec is not None else None)
+        return json.dumps(result).encode()
+
+    def _apply(self, cmd: dict) -> dict:
+        op = cmd["op"]
+        name = cmd["name"]
+        rec = self.records.get(name)
+        if op == "create":
+            if rec is not None:
+                return {"ok": False, "error": "exists", "epoch": rec.epoch}
+            rec = ReconfigurationRecord(
+                name=name, epoch=int(cmd.get("epoch", 0)),
+                actives=sorted(cmd["actives"]),
+            )
+            self.records[name] = rec
+            return {"ok": True, "epoch": rec.epoch}
+        if rec is None:
+            return {"ok": False, "error": "unknown"}
+        if op == "reconfigure_intent":
+            # READY -> WAIT_ACK_STOP (RCRecordRequest RECONFIGURATION_INTENT)
+            ok = rec.set_intent(cmd["new_actives"])
+            return {"ok": ok, "epoch": rec.epoch,
+                    "state": rec.state.value}
+        if op == "reconfigure_complete":
+            # WAIT_ACK_STOP -> READY @ epoch+1 (RECONFIGURATION_COMPLETE);
+            # guarded so duplicate completes (failover re-runs) are no-ops
+            if rec.state != RCState.WAIT_ACK_STOP or (
+                rec.epoch != int(cmd["epoch"])
+            ):
+                return {"ok": False, "error": "wrong_state",
+                        "state": rec.state.value, "epoch": rec.epoch}
+            ok = rec.set_complete()
+            return {"ok": ok, "epoch": rec.epoch}
+        if op == "delete_intent":
+            ok = rec.set_delete_intent(now=cmd.get("now"))
+            return {"ok": ok, "state": rec.state.value, "epoch": rec.epoch}
+        if op == "delete_complete":
+            if rec.state != RCState.WAIT_DELETE:
+                return {"ok": False, "error": "wrong_state",
+                        "state": rec.state.value}
+            del self.records[name]
+            return {"ok": True}
+        return {"ok": False, "error": f"bad op {op}"}
+
+    def _in_scope(self, service_name: str, group_name: str) -> bool:
+        return self.scope is None or self.scope(service_name, group_name)
+
+    def checkpoint(self, name: str) -> bytes:
+        with self._lock:
+            return json.dumps({
+                n: r.to_dict() for n, r in self.records.items()
+                if self._in_scope(n, name)
+            }).encode()
+
+    def restore(self, name: str, state: bytes) -> None:
+        with self._lock:
+            kept = {
+                n: r for n, r in self.records.items()
+                if not self._in_scope(n, name)
+            }
+            if state:
+                kept.update({
+                    n: ReconfigurationRecord.from_dict(d)
+                    for n, d in json.loads(state.decode()).items()
+                })
+            self.records = kept
+
+
+class RepliconfigurableReconfiguratorDB:
+    """The commit path: one shared RC-side PaxosManager whose replica slots
+    are the reconfigurator nodes and whose apps are their DB replicas.
+
+    RC paxos groups are created lazily per consistent-hash group (the
+    reference creates them eagerly at boot from the ring,
+    RepliconfigurableReconfiguratorDB.java:54); group ``_RC:A:B:C`` has
+    members {A,B,C}.  ``commit`` proposes a command to the group owning the
+    name and fires ``callback(result_dict)`` when it executes on the
+    proposer's DB replica.
+    """
+
+    def __init__(
+        self,
+        manager: PaxosManager,
+        rc_ids: List[str],
+        k: int = 3,
+    ):
+        self.manager = manager
+        self.rc_ids = sorted(rc_ids)
+        self._slot = {n: i for i, n in enumerate(self.rc_ids)}
+        self.ring = ConsistentHashRing(self.rc_ids)
+        self.k = min(k, len(self.rc_ids))
+        for app in manager.apps:
+            if isinstance(app, ReconfiguratorDB):
+                app.scope = (
+                    lambda sname, gname: self._pax_group(self.rc_group_of(sname))
+                    == gname
+                )
+
+    # ---------------------------------------------------------------- groups
+    def rc_group_of(self, name: str) -> List[str]:
+        """The k reconfigurators owning ``name`` (its RC group)."""
+        return self.ring.replicated_servers(name, self.k)
+
+    def primary_of(self, name: str) -> str:
+        return self.rc_group_of(name)[0]
+
+    def _pax_group(self, rcs: List[str]) -> str:
+        return RC_GROUP_PREFIX + ":".join(sorted(rcs))
+
+    def _ensure_group(self, rcs: List[str]) -> str:
+        gname = self._pax_group(rcs)
+        slots = [self._slot[r] for r in rcs]
+        self.manager.create_paxos_instance(gname, slots)  # idempotent (False if exists)
+        return gname
+
+    # ---------------------------------------------------------------- commit
+    def commit(
+        self,
+        name: str,
+        cmd: dict,
+        callback: Optional[Callable[[dict], None]] = None,
+        proposer: Optional[str] = None,
+    ) -> Optional[int]:
+        """Paxos-commit one record command for ``name``; the callback gets
+        the decoded result dict (or ``{"ok": False, "error": "failed"}``)."""
+        gname = self._ensure_group(self.rc_group_of(name))
+        entry = self._slot.get(proposer) if proposer else None
+
+        def cb(rid: int, resp: Optional[bytes]) -> None:
+            if callback is None:
+                return
+            if resp is None:
+                callback({"ok": False, "error": "failed"})
+            else:
+                callback(json.loads(resp.decode()))
+
+        return self.manager.propose(
+            gname, json.dumps(cmd).encode(),
+            cb if callback is not None else None, entry=entry,
+        )
+
+    def db_of(self, rc_id: str) -> ReconfiguratorDB:
+        return self.manager.apps[self._slot[rc_id]]

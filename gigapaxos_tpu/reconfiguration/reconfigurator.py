@@ -1,0 +1,532 @@
+"""Reconfigurator: the control-plane brain.
+
+Analog of ``reconfiguration/Reconfigurator.java:128``.  Handles client name
+management (``handleCreateServiceName :505``, ``handleDeleteServiceName
+:768``, ``handleRequestActiveReplicas :910``), demand-driven migration
+(``handleDemandReport :332``), and drives the epoch-change workflow through
+protocol tasks — the direct analogs of
+``reconfigurationprotocoltasks/``:
+
+* :class:`WaitAckStopEpoch` (WaitAckStopEpoch.java:38) — stop the old epoch
+  at a majority of its actives;
+* :class:`WaitAckStartEpoch` (WaitAckStartEpoch.java:50) — start the new
+  epoch at a majority of the new actives;
+* :class:`WaitAckDropEpoch` (WaitAckDropEpoch.java:45) — lazily GC the old
+  epoch's final state (bounded retries);
+* :class:`WaitPrimaryExecution` (WaitPrimaryExecution.java:60) — non-primary
+  members of a name's RC group watchdog an in-flight reconfiguration and
+  take over if the primary dies mid-workflow.
+
+Every step is gated on a paxos-committed record mutation through the
+replicated :mod:`rc_db` (RCRecordRequest intents/completes committed by
+``CommitWorker``, CommitWorker.java:46 — here the commit liveness comes from
+the data plane's own retry loop plus task restarts), so any RC replica can
+resume the workflow from the record state alone.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..net.messenger import Messenger
+from ..protocoltask.executor import ProtocolExecutor, ProtocolTask
+from . import packets as pkt
+from .consistent_hashing import ConsistentHashRing
+from .demand import AbstractDemandProfile, DemandProfile
+from .rc_db import NC_RECORD, RepliconfigurableReconfiguratorDB
+from .records import RCState
+
+
+def _majority(n: int) -> int:
+    return n // 2 + 1
+
+
+class _WaitAcks(ProtocolTask):
+    """Shared shape of the epoch ack-threshold tasks: multicast a packet to a
+    node set, complete at a threshold of distinct acks (ThresholdProtocolTask
+    analog, protocoltask/ThresholdProtocolTask.java)."""
+
+    period_s = 0.5
+    #: backup/watchdog instances set this so the initial schedule() sends
+    #: nothing — only the first periodic restart does, giving the primary's
+    #: own task a head start before duplicate packets go out
+    first_delayed = False
+
+    def __init__(self, targets: List[str], threshold: int,
+                 on_done: Optional[Callable[[], None]] = None):
+        self.targets = list(targets)
+        self.threshold = threshold
+        self.acked: set = set()
+        self._on_done = on_done
+        self._held_first = False
+
+    def packet(self) -> dict:
+        raise NotImplementedError
+
+    def start(self):
+        if self.first_delayed and not self._held_first:
+            self._held_first = True
+            return []
+        p = self.packet()
+        return [(t, dict(p)) for t in self.targets if t not in self.acked]
+
+    def handle(self, event: dict):
+        sender = event.get("sender")
+        if sender in self.targets:
+            self.acked.add(sender)
+        return [], len(self.acked) >= self.threshold
+
+    def on_done(self):
+        if self._on_done is not None:
+            self._on_done()
+
+
+class WaitAckStopEpoch(_WaitAcks):
+    def __init__(self, rc: "Reconfigurator", name: str, epoch: int,
+                 actives: List[str], on_done):
+        super().__init__(actives, _majority(len(actives)), on_done)
+        self.rc, self.name, self.epoch = rc, name, epoch
+
+    @property
+    def key(self) -> str:
+        return f"WaitAckStopEpoch:{self.name}:{self.epoch}"
+
+    def packet(self) -> dict:
+        return pkt.stop_epoch(self.name, self.epoch, self.rc.node_id)
+
+
+class WaitAckStartEpoch(_WaitAcks):
+    def __init__(self, rc: "Reconfigurator", name: str, epoch: int,
+                 actives: List[str], prev_epoch: int, prev_actives: List[str],
+                 initial_state: Optional[bytes], on_done):
+        super().__init__(actives, _majority(len(actives)), on_done)
+        self.rc, self.name, self.epoch = rc, name, epoch
+        self.prev_epoch, self.prev_actives = prev_epoch, prev_actives
+        self.initial_state = initial_state
+
+    @property
+    def key(self) -> str:
+        return f"WaitAckStartEpoch:{self.name}:{self.epoch}"
+
+    def packet(self) -> dict:
+        return pkt.start_epoch(
+            self.name, self.epoch, self.targets, self.rc.node_id,
+            self.prev_epoch, self.prev_actives, self.initial_state,
+        )
+
+
+class WaitAckDropEpoch(_WaitAcks):
+    """GC task: wants *all* acks but gives up after max_restarts (the
+    reference's WaitAckDropEpoch similarly bounds retries)."""
+
+    period_s = 1.0
+    max_restarts = 8
+
+    def __init__(self, rc: "Reconfigurator", name: str, epoch: int,
+                 actives: List[str], on_done=None):
+        super().__init__(actives, len(actives), on_done)
+        self.rc, self.name, self.epoch = rc, name, epoch
+
+    @property
+    def key(self) -> str:
+        return f"WaitAckDropEpoch:{self.name}:{self.epoch}"
+
+    def packet(self) -> dict:
+        return pkt.drop_epoch(self.name, self.epoch, self.rc.node_id)
+
+
+class WaitPrimaryExecution(ProtocolTask):
+    """Failover watchdog: a non-primary RC sees an intent commit and waits;
+    if the record is still mid-reconfiguration after a grace period and the
+    primary looks dead (or enough restarts pass), this RC re-drives the
+    workflow — safe because every step is idempotent and record-gated."""
+
+    period_s = 2.0
+    max_restarts = 30
+
+    def __init__(self, rc: "Reconfigurator", name: str, epoch: int,
+                 takeover_after: int = 2):
+        self.rc, self.name, self.epoch = rc, name, epoch
+        self.takeover_after = takeover_after
+        self._fires = 0
+
+    @property
+    def key(self) -> str:
+        return f"WaitPrimaryExecution:{self.name}:{self.epoch}"
+
+    def start(self):
+        return []
+
+    def restart(self):
+        rec = self.rc.db.get(self.name)
+        if rec is None or (rec.state == RCState.READY and rec.epoch > self.epoch):
+            self.rc.executor.cancel(self.key)  # workflow finished
+            return []
+        self._fires += 1
+        primary_dead = not self.rc.is_node_up(self.rc.rdb.primary_of(self.name))
+        if primary_dead or self._fires >= self.takeover_after:
+            self.rc.executor.cancel(self.key)
+            self.rc._resume_workflow(self.name)
+        return []
+
+    def handle(self, event):
+        return [], True  # explicit completion event (unused today)
+
+
+class Reconfigurator:
+    def __init__(
+        self,
+        node_id: str,
+        messenger: Messenger,
+        rdb: RepliconfigurableReconfiguratorDB,
+        active_ids: List[str],
+        replicas_per_name: int = 3,
+        demand_profile_factory: Callable[[str], AbstractDemandProfile] = DemandProfile,
+        is_node_up: Optional[Callable[[str], bool]] = None,
+    ):
+        self.node_id = node_id
+        self.m = messenger
+        self.rdb = rdb
+        self.db = rdb.db_of(node_id)
+        self.db.listener = self._on_db_commit
+        self.actives_pool: List[str] = sorted(active_ids)
+        self.actives_ring = ConsistentHashRing(self.actives_pool)
+        self.k = replicas_per_name
+        self.profile_factory = demand_profile_factory
+        self._profiles: Dict[str, AbstractDemandProfile] = {}
+        self._lock = threading.RLock()
+        self.is_node_up = is_node_up or (lambda n: True)
+        #: in-flight client replies: name -> (client_id, reply_packet_base)
+        self._pending_reply: Dict[str, tuple] = {}
+        self.executor = ProtocolExecutor(self.m.send, name=f"rc-{node_id}")
+        for ptype, h in [
+            (pkt.CREATE_SERVICE_NAME, self._on_create),
+            (pkt.DELETE_SERVICE_NAME, self._on_delete),
+            (pkt.REQUEST_ACTIVE_REPLICAS, self._on_request_actives),
+            (pkt.CLIENT_RECONFIGURE, self._on_client_reconfigure),
+            (pkt.DEMAND_REPORT, self._on_demand_report),
+            (pkt.ACK_STOP_EPOCH, self._route_ack("WaitAckStopEpoch")),
+            (pkt.ACK_START_EPOCH, self._route_ack("WaitAckStartEpoch")),
+            (pkt.ACK_DROP_EPOCH, self._route_ack("WaitAckDropEpoch")),
+        ]:
+            self.m.register(ptype, h)
+
+    def close(self) -> None:
+        self.executor.stop()
+        self.m.close()
+
+    # ------------------------------------------------------------- placement
+    def initial_actives(self, name: str) -> List[str]:
+        """Default placement: consistent-hash the name onto the active pool
+        (ReconfigurationConfig's default placement policy)."""
+        return self.actives_ring.replicated_servers(
+            name, min(self.k, len(self.actives_pool))
+        )
+
+    def _route_ack(self, task: str):
+        def h(sender: str, p: dict) -> None:
+            self.executor.handle_event(f"{task}:{p['name']}:{p['epoch']}", p)
+        return h
+
+    # ------------------------------------------------------------ name create
+    def _on_create(self, sender: str, p: dict) -> None:
+        pkt.register_client(self.m.nodemap, p)
+        name, rid = p["name"], p["rid"]
+        state = pkt.b64d(p["initial_state"]) or b""
+        actives = self.initial_actives(name)
+
+        def committed(result: dict) -> None:
+            if not result.get("ok"):
+                self.m.send(sender, {
+                    "type": pkt.CREATE_RESPONSE, "rid": rid, "name": name,
+                    "ok": False, "error": result.get("error", "failed"),
+                })
+                return
+
+            def started() -> None:
+                self.m.send(sender, {
+                    "type": pkt.CREATE_RESPONSE, "rid": rid, "name": name,
+                    "ok": True, "actives": actives,
+                })
+
+            # a stale backup task from a previous incarnation of this name
+            # (deleted then recreated at epoch 0) would block this key and
+            # orphan the client response — evict it first
+            self.executor.cancel(f"WaitAckStartEpoch:{name}:0")
+            self.executor.schedule(WaitAckStartEpoch(
+                self, name, 0, actives, -1, [], state, started
+            ))
+
+        # origin + initial_state ride inside the replicated command so any
+        # RC-group member can re-send the creation StartEpoch if this RC
+        # dies between the commit and the delivery (see _on_db_commit)
+        self.rdb.commit(
+            name,
+            {"op": "create", "name": name, "actives": actives,
+             "origin": self.node_id, "initial_state": p["initial_state"]},
+            committed, proposer=self.node_id,
+        )
+
+    # ------------------------------------------------------------ name delete
+    def _on_delete(self, sender: str, p: dict) -> None:
+        pkt.register_client(self.m.nodemap, p)
+        name, rid = p["name"], p["rid"]
+
+        def committed(result: dict) -> None:
+            if not result.get("ok"):
+                self.m.send(sender, {
+                    "type": pkt.DELETE_RESPONSE, "rid": rid, "name": name,
+                    "ok": False, "error": result.get("error", "failed"),
+                })
+                return
+            rec = self.db.get(name)
+            epoch = rec.epoch if rec is not None else int(result.get("epoch", 0))
+            actives = list(rec.actives) if rec is not None else []
+
+            def stopped() -> None:
+                def deleted(res: dict) -> None:
+                    self.m.send(sender, {
+                        "type": pkt.DELETE_RESPONSE, "rid": rid, "name": name,
+                        "ok": bool(res.get("ok")),
+                    })
+
+                def dropped() -> None:
+                    # the record stays WAIT_DELETE until the old epoch's
+                    # state is GC'd everywhere (or the drop task ages out —
+                    # the MAX_FINAL_STATE_AGE grace), so a recreate at epoch
+                    # 0 can never race an in-flight drop of the old instance
+                    self.rdb.commit(
+                        name, {"op": "delete_complete", "name": name},
+                        deleted, proposer=self.node_id,
+                    )
+
+                if actives:
+                    self.executor.schedule(
+                        WaitAckDropEpoch(self, name, epoch, actives, dropped)
+                    )
+                else:
+                    dropped()
+
+            if actives:
+                self.executor.schedule(
+                    WaitAckStopEpoch(self, name, epoch, actives, stopped)
+                )
+            else:
+                stopped()
+
+        self.rdb.commit(
+            name, {"op": "delete_intent", "name": name, "now": time.time()},
+            committed, proposer=self.node_id,
+        )
+
+    # -------------------------------------------------------- actives lookup
+    def _on_request_actives(self, sender: str, p: dict) -> None:
+        pkt.register_client(self.m.nodemap, p)
+        name, rid = p["name"], p["rid"]
+        rec = self.db.get(name)
+        if rec is None or rec.state == RCState.WAIT_DELETE:
+            self.m.send(sender, {
+                "type": pkt.ACTIVES_RESPONSE, "rid": rid, "name": name,
+                "ok": False, "error": "unknown_name",
+            })
+            return
+        addrs = {}
+        for a in rec.actives:
+            addr = self.m.nodemap(a)
+            if addr is not None:
+                addrs[a] = [addr[0], addr[1]]
+        self.m.send(sender, {
+            "type": pkt.ACTIVES_RESPONSE, "rid": rid, "name": name, "ok": True,
+            "epoch": rec.epoch, "actives": list(rec.actives), "addrs": addrs,
+        })
+
+    # -------------------------------------------------------- reconfiguration
+    def _on_demand_report(self, sender: str, p: dict) -> None:
+        """handleDemandReport (Reconfigurator.java:332): aggregate, ask the
+        policy, and (primary only) kick off a migration."""
+        name = p["name"]
+        with self._lock:
+            prof = self._profiles.get(name)
+            if prof is None:
+                prof = self._profiles[name] = self.profile_factory(name)
+            prof.combine(p["stats"])
+        if self.rdb.primary_of(name) != self.node_id:
+            return
+        rec = self.db.get(name)
+        if rec is None or not rec.can_reconfigure():
+            return
+        new_actives = prof.reconfigure(list(rec.actives), self.actives_pool)
+        if new_actives:
+            new_actives = [a for a in new_actives if a in self.actives_pool]
+        if new_actives and sorted(new_actives) != sorted(rec.actives):
+            self._reconfigure(name, sorted(new_actives), on_done=prof.just_reconfigured)
+
+    def _on_client_reconfigure(self, sender: str, p: dict) -> None:
+        pkt.register_client(self.m.nodemap, p)
+        name, rid = p["name"], p["rid"]
+        requested = p.get("new_actives") or []
+        bad = [a for a in requested if a not in self.actives_pool]
+        if not requested or bad:
+            # committing an unknown/empty active set would brick the name:
+            # the old epoch gets stopped but no reachable new epoch starts
+            self.m.send(sender, {
+                "type": pkt.RECONFIGURE_RESPONSE, "rid": rid, "name": name,
+                "ok": False, "error": f"bad_actives:{','.join(bad) or 'empty'}",
+            })
+            return
+        rec = self.db.get(name)
+        if rec is None or not rec.can_reconfigure():
+            self.m.send(sender, {
+                "type": pkt.RECONFIGURE_RESPONSE, "rid": rid, "name": name,
+                "ok": False,
+                "error": "unknown_name" if rec is None else "busy",
+            })
+            return
+
+        def done() -> None:
+            self.m.send(sender, {
+                "type": pkt.RECONFIGURE_RESPONSE, "rid": rid, "name": name,
+                "ok": True, "actives": sorted(p["new_actives"]),
+            })
+
+        ok = self._reconfigure(name, sorted(p["new_actives"]), on_done=done)
+        if not ok:
+            self.m.send(sender, {
+                "type": pkt.RECONFIGURE_RESPONSE, "rid": rid, "name": name,
+                "ok": False, "error": "busy",
+            })
+
+    def _reconfigure(self, name: str, new_actives: List[str],
+                     on_done: Optional[Callable[[], None]] = None) -> bool:
+        """Drive READY -> intent -> stop old -> complete -> start new
+        (§3.4's full chain).  Returns False if the intent can't be placed."""
+        rec = self.db.get(name)
+        if rec is None or not rec.can_reconfigure():
+            return False
+
+        def intent_committed(result: dict) -> None:
+            if not result.get("ok"):
+                return  # raced with another workflow; watchdogs cover it
+            self._drive_stop_then_start(name, on_done)
+
+        self.rdb.commit(
+            name,
+            {"op": "reconfigure_intent", "name": name,
+             "new_actives": new_actives},
+            intent_committed, proposer=self.node_id,
+        )
+        return True
+
+    def _drive_stop_then_start(
+        self, name: str, on_done: Optional[Callable[[], None]] = None
+    ) -> None:
+        """From a committed WAIT_ACK_STOP record, run the rest of the epoch
+        change.  Used by both the primary path and failover resume.
+
+        Ordering: stop old -> start new -> commit reconfigure_complete ->
+        GC old.  The complete is committed only after a majority of the new
+        epoch acked StartEpoch, so the record stays WAIT_ACK_STOP for the
+        whole in-flight window — which is exactly what lets
+        WaitPrimaryExecution on any RC re-drive the workflow from the record
+        alone if the driving RC crashes at any point (every step below is
+        idempotent)."""
+        rec = self.db.get(name)
+        if rec is None or rec.state != RCState.WAIT_ACK_STOP:
+            return
+        old_epoch, old_actives = rec.epoch, list(rec.actives)
+        new_actives = list(rec.new_actives)
+
+        def stopped() -> None:
+            def started() -> None:
+                def completed(result: dict) -> None:
+                    # ok=False means another RC completed it first — the
+                    # epoch changed either way, so GC and finish
+                    self.executor.schedule(
+                        WaitAckDropEpoch(self, name, old_epoch, old_actives)
+                    )
+                    if on_done is not None:
+                        on_done()
+
+                self.rdb.commit(
+                    name,
+                    {"op": "reconfigure_complete", "name": name,
+                     "epoch": old_epoch},
+                    completed, proposer=self.node_id,
+                )
+
+            self.executor.schedule(WaitAckStartEpoch(
+                self, name, old_epoch + 1, new_actives,
+                old_epoch, old_actives, None, started,
+            ))
+
+        self.executor.schedule(
+            WaitAckStopEpoch(self, name, old_epoch, old_actives, stopped)
+        )
+
+    def _resume_workflow(self, name: str) -> None:
+        """Failover entry (WaitPrimaryExecution takeover): re-drive whatever
+        the record state says is unfinished."""
+        rec = self.db.get(name)
+        if rec is None:
+            return
+        if rec.state == RCState.WAIT_ACK_STOP:
+            self._drive_stop_then_start(name)
+        elif rec.state == RCState.WAIT_DELETE:
+            def stopped() -> None:
+                def dropped() -> None:
+                    # same drop-before-delete_complete gating as the primary
+                    # delete path: a recreate at epoch 0 must never race an
+                    # in-flight drop of the old instance
+                    self.rdb.commit(
+                        name, {"op": "delete_complete", "name": name},
+                        proposer=self.node_id,
+                    )
+                self.executor.schedule(WaitAckDropEpoch(
+                    self, name, rec.epoch, list(rec.actives), dropped
+                ))
+            self.executor.schedule(WaitAckStopEpoch(
+                self, name, rec.epoch, list(rec.actives), stopped
+            ))
+
+    # --------------------------------------------------------- commit events
+    def _on_db_commit(self, cmd: dict, record: Optional[dict]) -> None:
+        """Listener on this node's DB replica: non-primary RC-group members
+        arm the failover watchdog when they see an intent commit."""
+        name = cmd.get("name")
+        if name is None or name == NC_RECORD:
+            return
+        op = cmd.get("op")
+        if op == "delete_complete":
+            with self._lock:
+                self._profiles.pop(name, None)
+            # kill any lingering start/drop tasks for the dead name so a
+            # later recreate at epoch 0 neither collides on task keys nor
+            # gets zombie-resurrected by a stale backup StartEpoch
+            for key in self.executor.pending():
+                if key.split(":")[0] in (
+                    "WaitAckStartEpoch", "WaitPrimaryExecution"
+                ) and key.split(":")[1:-1] == name.split(":"):
+                    self.executor.cancel(key)
+            return
+        in_group = self.node_id in self.rdb.rc_group_of(name)
+        if op in ("reconfigure_intent", "delete_intent"):
+            if in_group and self.rdb.primary_of(name) != self.node_id:
+                epoch = record["epoch"] if record else 0
+                self.executor.schedule(WaitPrimaryExecution(self, name, epoch))
+        elif op == "create" and record is not None:
+            if in_group and cmd.get("origin") != self.node_id:
+                # backup creation driver: if the origin RC dies before its
+                # StartEpochs go out, this (delayed, idempotent) task still
+                # births the name's epoch-0 group
+                t = WaitAckStartEpoch(
+                    self, name, record["epoch"], record["actives"], -1, [],
+                    pkt.b64d(cmd.get("initial_state")) or b"", None,
+                )
+                t.first_delayed = True
+                t.period_s = 2.0
+                # evict a stale same-key backup from a deleted incarnation
+                # (it would otherwise block this one and push stale state)
+                self.executor.cancel(t.key)
+                self.executor.schedule(t)

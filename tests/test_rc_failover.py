@@ -1,0 +1,115 @@
+"""Failover and edge-case tests for the reconfiguration control plane.
+
+Covers the crash windows the reference guards with ``WaitPrimaryExecution``
+(reconfigurationprotocoltasks/WaitPrimaryExecution.java:60) and the
+record-gated idempotence of the epoch workflow: a reconfiguration must
+survive the driving RC dying at any point after the intent commits.
+"""
+
+import time
+
+import pytest
+
+from gigapaxos_tpu.client import ReconfigurableAppClient
+from gigapaxos_tpu.config import GigapaxosTpuConfig
+from gigapaxos_tpu.models.replicable import KVApp
+from gigapaxos_tpu.node import InProcessCluster
+from gigapaxos_tpu.reconfiguration.rc_db import ReconfiguratorDB
+from gigapaxos_tpu.reconfiguration.records import RCState
+
+
+def make_cfg(n_active=5, n_rc=3):
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 64
+    for i in range(n_active):
+        cfg.nodes.actives[f"AR{i}"] = ("127.0.0.1", 0)
+    for i in range(n_rc):
+        cfg.nodes.reconfigurators[f"RC{i}"] = ("127.0.0.1", 0)
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    cl = InProcessCluster(make_cfg(), KVApp)
+    yield cl
+    cl.close()
+
+
+@pytest.fixture(scope="module")
+def client(cluster):
+    c = ReconfigurableAppClient(cluster.cfg.nodes)
+    yield c
+    c.close()
+
+
+def test_stuck_intent_recovered_by_watchdog(cluster, client):
+    """An intent committed with no driving workflow (the 'primary crashed
+    right after committing the intent' window) must be picked up by another
+    RC-group member's WaitPrimaryExecution and driven to completion."""
+    assert client.create("orphan")["ok"]
+    assert client.request("orphan", b"PUT z 9") == b"OK"
+    primary = cluster.rdb.primary_of("orphan")
+    rec = cluster.reconfigurators[primary].db.get("orphan")
+    old = set(rec.actives)
+    new = sorted(set(cluster.cfg.nodes.active_ids()) - old | set(sorted(old)[:1]))[:3]
+    # commit the intent exactly as the primary would, then "crash" it by
+    # never scheduling the workflow and marking it down for the watchdogs
+    cluster.set_node_up(primary, False)
+    done = []
+    cluster.rdb.commit(
+        "orphan",
+        {"op": "reconfigure_intent", "name": "orphan", "new_actives": new},
+        lambda r: done.append(r), proposer=primary,
+    )
+    deadline = time.monotonic() + 30
+    rec2 = None
+    while time.monotonic() < deadline:
+        rec2 = cluster.reconfigurators[primary].db.get("orphan")
+        if rec2 is not None and rec2.state == RCState.READY and rec2.epoch == 1:
+            break
+        time.sleep(0.25)
+    cluster.set_node_up(primary, True)
+    assert rec2 is not None and rec2.epoch == 1, (
+        f"watchdog never completed the orphaned intent: {rec2}"
+    )
+    # data survived the failover-driven migration
+    assert client.request("orphan", b"GET z") == b"9"
+
+
+def test_record_stays_wait_ack_stop_until_new_epoch_started(cluster, client):
+    """reconfigure_complete must not commit before the new epoch is started
+    at a majority — the record state is the failover handle."""
+    assert client.create("gate")["ok"]
+    primary = cluster.rdb.primary_of("gate")
+    rec = cluster.reconfigurators[primary].db.get("gate")
+    assert rec.state == RCState.READY and rec.epoch == 0
+
+
+def test_reconfigure_rejects_bad_actives(cluster, client):
+    assert client.create("valid")["ok"]
+    r = client.reconfigure("valid", ["NOPE1", "NOPE2", "NOPE3"])
+    assert r["ok"] is False and "bad_actives" in r["error"]
+    r = client.reconfigure("valid", [])
+    assert r["ok"] is False
+    # name still fully usable
+    assert client.request("valid", b"PUT a 1") == b"OK"
+
+
+def test_rc_db_checkpoint_scoped():
+    """A checkpoint of one RC paxos group must not contain (or clobber)
+    records owned by other RC groups."""
+    db = ReconfiguratorDB("X")
+    db.scope = lambda sname, gname: (sname < "m") == (gname == "_RC:low")
+    import json
+    db.execute("_RC:low", json.dumps(
+        {"op": "create", "name": "alpha", "actives": ["A"]}).encode(), 1)
+    db.execute("_RC:high", json.dumps(
+        {"op": "create", "name": "zeta", "actives": ["B"]}).encode(), 2)
+    ck_low = db.checkpoint("_RC:low")
+    assert b"alpha" in ck_low and b"zeta" not in ck_low
+    # restoring the low group's checkpoint must keep the high group's records
+    db.restore("_RC:low", ck_low)
+    assert db.get("zeta") is not None and db.get("alpha") is not None
+    # and restoring empty state for low wipes only low
+    db.restore("_RC:low", b"")
+    assert db.get("alpha") is None and db.get("zeta") is not None

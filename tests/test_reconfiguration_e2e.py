@@ -1,0 +1,139 @@
+"""End-to-end control-plane test: a whole deployment in one process.
+
+The analog of ``TESTReconfigurationClient`` driven by
+``TESTReconfigurationMain.startLocalServers``
+(reconfiguration/testing/TESTReconfigurationMain.java:86 +
+TESTReconfigurationClient.java:676-1002): real sockets on loopback, real
+reconfigurators with their paxos-replicated DB, real active replicas over
+the dense device data plane — create/request/reconfigure/delete, state
+carried across epochs.
+"""
+
+import pytest
+
+from gigapaxos_tpu.client import ClientError, ReconfigurableAppClient
+from gigapaxos_tpu.config import GigapaxosTpuConfig
+from gigapaxos_tpu.models.replicable import KVApp
+from gigapaxos_tpu.node import InProcessCluster
+from gigapaxos_tpu.reconfiguration.demand import RateBasedMigrationPolicy
+
+
+def make_cfg(n_active=5, n_rc=3):
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 64
+    cfg.paxos.window = 8
+    for i in range(n_active):
+        cfg.nodes.actives[f"AR{i}"] = ("127.0.0.1", 0)
+    for i in range(n_rc):
+        cfg.nodes.reconfigurators[f"RC{i}"] = ("127.0.0.1", 0)
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    cl = InProcessCluster(
+        make_cfg(),
+        KVApp,
+        demand_profile_factory=lambda name: RateBasedMigrationPolicy(
+            name, migrate_after=25
+        ),
+    )
+    yield cl
+    cl.close()
+
+
+@pytest.fixture(scope="module")
+def client(cluster):
+    c = ReconfigurableAppClient(cluster.cfg.nodes)
+    yield c
+    c.close()
+
+
+def test_create_and_request(cluster, client):
+    resp = client.create("svc0")
+    assert resp["ok"], resp
+    actives = client.request_actives("svc0")
+    assert len(actives) == 3
+    assert set(actives) <= set(cluster.cfg.nodes.active_ids())
+    assert client.request("svc0", b"PUT k v1") == b"OK"
+    assert client.request("svc0", b"GET k") == b"v1"
+
+
+def test_duplicate_create_fails(cluster, client):
+    assert client.create("svc0")["ok"] is False
+
+
+def test_unknown_name(cluster, client):
+    with pytest.raises(ClientError):
+        client.request_actives("nope", force=True)
+
+
+def test_many_names_spread(cluster, client):
+    seen = set()
+    for i in range(6):
+        name = f"spread{i}"
+        assert client.create(name)["ok"]
+        seen.update(client.request_actives(name))
+        assert client.request(name, b"PUT a 1") == b"OK"
+    # consistent hashing should use more than one 3-subset of 5 actives
+    assert len(seen) > 3
+
+
+def test_client_reconfigure_preserves_state(cluster, client):
+    assert client.create("mig")["ok"]
+    assert client.request("mig", b"PUT city amherst") == b"OK"
+    old = set(client.request_actives("mig"))
+    pool = set(cluster.cfg.nodes.active_ids())
+    new = sorted((pool - old) | set(sorted(old)[:1]))[:3]
+    assert set(new) != old
+    resp = client.reconfigure("mig", new)
+    assert resp["ok"], resp
+    got = set(client.request_actives("mig", force=True))
+    assert got == set(new)
+    # state survived the epoch change via final-state transfer
+    assert client.request("mig", b"GET city") == b"amherst"
+    assert client.request("mig", b"PUT t 2") == b"OK"
+    # record advanced to epoch 1 on every RC replica of the name's group
+    rc = cluster.reconfigurators[cluster.rdb.primary_of("mig")]
+    rec = rc.db.get("mig")
+    assert rec.epoch == 1 and rec.state.value == "READY"
+
+
+def test_delete(cluster, client):
+    assert client.create("gone")["ok"]
+    assert client.request("gone", b"PUT x 1") == b"OK"
+    resp = client.delete("gone")
+    assert resp["ok"], resp
+    with pytest.raises(ClientError):
+        client.request_actives("gone", force=True)
+    # re-creating the same name starts fresh at epoch 0
+    assert client.create("gone")["ok"]
+    assert client.request("gone", b"GET x") == b"NF"
+
+
+def test_demand_driven_migration(cluster, client):
+    """RateBasedMigrationPolicy(migrate_after=25): enough requests must
+    trigger a primary-RC-driven migration without any client involvement."""
+    import time
+
+    assert client.create("hot")["ok"]
+    before = set(client.request_actives("hot"))
+    for i in range(40):
+        client.request("hot", f"PUT k{i} {i}".encode())
+    deadline = time.monotonic() + 20
+    after = before
+    while time.monotonic() < deadline:
+        after = set(client.request_actives("hot", force=True))
+        if after != before:
+            break
+        client.request("hot", b"GET k0")
+        time.sleep(0.25)
+    assert after != before, "demand-driven migration never happened"
+    # data survived
+    assert client.request("hot", b"GET k1") == b"1"
+
+
+def test_echo_rtt(cluster, client):
+    a = client.request_actives("svc0")[0]
+    rtt = client.echo(a)
+    assert 0 <= rtt < 5

@@ -1,0 +1,4 @@
+from .coordinator import ChainReplicaCoordinator
+from .manager import ChainManager
+
+__all__ = ["ChainManager", "ChainReplicaCoordinator"]

@@ -1,0 +1,24 @@
+"""ChainReplicaCoordinator: chains behind the replica-coordination SPI.
+
+Analog of ``reconfiguration/ChainReplicaCoordinator.java`` (selected by
+``REPLICA_COORDINATOR_CLASS``, ReconfigurableNode.java:203-218): the entire
+reconfiguration control plane — epoch lifecycle, demand migration, final
+state transfer — runs unchanged over chains instead of paxos groups.
+
+Because :class:`ChainManager` exposes the same host surface as
+``PaxosManager``, the binding *is* the paxos binding with a chain manager
+underneath; this subclass exists as the named extension point (policy knobs
+that differ per protocol land here).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..reconfiguration.coordinator import PaxosReplicaCoordinator
+from .manager import ChainManager
+
+
+class ChainReplicaCoordinator(PaxosReplicaCoordinator):
+    def __init__(self, manager: ChainManager, node_ids: List[str]):
+        super().__init__(manager, node_ids)

@@ -1,0 +1,107 @@
+"""Dense device-resident chain-replication state.
+
+The second per-group coordination protocol over the same group-table
+infrastructure (SURVEY §2.4): the reference's
+``chainreplication/ReplicatedChainStateMachine.java:28`` keeps per-chain
+(members, head, tail, slot); here those become dense arrays with one row per
+chain, sharing the ``[R, G]`` / ``[R, W, G]`` layout conventions of
+``paxos/state.py`` (G minor/lane axis, W in sublanes).
+
+Chain order is the ascending replica-slot order of the member mask: head =
+lowest member slot, tail = highest.  Each replica's received-log window is a
+ring ``[R, W, G]`` that fills one hop per tick from its predecessor — the
+device-synchronous analog of head-ordered FORWARD propagation
+(``ChainManager.java:234-380``); the commit point is application at the
+tail (reads are served at the tail, class doc ``ChainManager.java:71-99``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import GroupStatus, NO_REQUEST
+
+I32 = jnp.int32
+BOOL = jnp.bool_
+
+
+class ChainState(NamedTuple):
+    # ---- per replica [R, G] ----
+    applied: jnp.ndarray  # next slot to apply at replica r (exec watermark)
+    status: jnp.ndarray  # GroupStatus per replica
+
+    # ---- received-log ring [R, W, G] ----
+    c_req: jnp.ndarray
+    c_slot: jnp.ndarray  # absolute slot held by the plane (-1 = empty)
+    c_stop: jnp.ndarray
+
+    # ---- per chain [G] ----
+    next_slot: jnp.ndarray  # head's assignment counter
+
+    # ---- group config ----
+    member: jnp.ndarray  # bool [R, G]
+    n_members: jnp.ndarray  # int32 [G]
+    epoch: jnp.ndarray  # int32 [G]
+
+    @property
+    def n_replica_slots(self) -> int:
+        return self.applied.shape[0]
+
+    @property
+    def n_groups(self) -> int:
+        return self.applied.shape[1]
+
+    @property
+    def window(self) -> int:
+        return self.c_req.shape[1]
+
+
+def init_state(n_replicas: int, n_groups: int, window: int) -> ChainState:
+    R, G, W = n_replicas, n_groups, window
+    return ChainState(
+        applied=jnp.zeros((R, G), I32),
+        status=jnp.full((R, G), int(GroupStatus.FREE), I32),
+        c_req=jnp.full((R, W, G), NO_REQUEST, I32),
+        c_slot=jnp.full((R, W, G), -1, I32),
+        c_stop=jnp.zeros((R, W, G), BOOL),
+        next_slot=jnp.zeros((G,), I32),
+        member=jnp.zeros((R, G), BOOL),
+        n_members=jnp.zeros((G,), I32),
+        epoch=jnp.zeros((G,), I32),
+    )
+
+
+def create_groups(state: ChainState, rows: np.ndarray, members: np.ndarray,
+                  epochs: np.ndarray | None = None) -> ChainState:
+    """Open chain rows (ChainManager.createReplicatedChain analog)."""
+    rows = jnp.asarray(rows, I32)
+    members = jnp.asarray(members, BOOL)
+    if epochs is None:
+        epochs = jnp.zeros((rows.shape[0],), I32)
+    else:
+        epochs = jnp.asarray(epochs, I32)
+    return state._replace(
+        applied=state.applied.at[:, rows].set(0),
+        status=state.status.at[:, rows].set(int(GroupStatus.ACTIVE)),
+        c_req=state.c_req.at[:, :, rows].set(NO_REQUEST),
+        c_slot=state.c_slot.at[:, :, rows].set(-1),
+        c_stop=state.c_stop.at[:, :, rows].set(False),
+        next_slot=state.next_slot.at[rows].set(0),
+        member=state.member.at[:, rows].set(members.T),
+        n_members=state.n_members.at[rows].set(
+            jnp.sum(members, axis=1).astype(I32)
+        ),
+        epoch=state.epoch.at[rows].set(epochs),
+    )
+
+
+def free_groups(state: ChainState, rows: np.ndarray) -> ChainState:
+    rows = jnp.asarray(rows, I32)
+    return state._replace(
+        status=state.status.at[:, rows].set(int(GroupStatus.FREE)),
+        member=state.member.at[:, rows].set(False),
+        n_members=state.n_members.at[rows].set(0),
+    )

@@ -1,0 +1,254 @@
+"""The fused chain-replication tick: every chain advances one hop per tick.
+
+Re-expresses the reference's per-packet chain handlers
+(``chainreplication/ChainManager.java:168-380``) as one branch-free device
+step over all chains:
+
+* head intake = ``handleChainRequest`` ordering writes (``propose :434``);
+* one-hop window copy from each replica's predecessor = the FORWARD packet
+  (``ChainPacket.CHAIN_FORWARD``, chainpackets/ChainPacket.java:119-133);
+* application at each replica as its window fills = the state-update on
+  forward;
+* the tail's application watermark = the ACK path / commit point (reads are
+  served at the tail).
+
+A dead mid-chain replica is routed around: live members re-link into a
+sub-chain (pred = nearest *live* upstream member) so writes — crucially
+including the epoch-stop the reconfiguration layer needs in order to remove
+the dead node — keep committing at the live tail.  This is the classic chain
+repair; it is safe here because the log is a single totally-ordered window
+(slots assigned once by the head), so a recovered member simply resumes
+copying from its live predecessor at its own watermark.  A dead *head* still
+blocks intake (nobody else may order writes), and a dead member freezes
+``min_applied``, so the window fills after W more slots — bounded progress
+that the reconfiguration layer resolves with a new epoch.
+
+Shapes follow ops/tick.py conventions: G is the minor (lane) axis; the
+replica axis R is the mesh axis under sharding; per-plane ring copies use
+the one-hot-select gather of ops/window.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.window import gather_planes
+from ..types import GroupStatus, NO_REQUEST
+
+I32 = jnp.int32
+
+
+class ChainInbox(NamedTuple):
+    """req/stop: int32/bool [P, G] — new client requests per chain (the host
+    routes all of a chain's traffic to its head, as clients send writes to
+    the head in the reference).  alive: bool [R]."""
+
+    req: jnp.ndarray
+    stop: jnp.ndarray
+    alive: jnp.ndarray
+
+
+class ChainOutbox(NamedTuple):
+    """exec_*: application events per replica this tick (plane j = slot
+    exec_base+j).  committed_now counts slots newly applied at the tail —
+    the chain's commit signal.  head_id/tail_id let the host route and
+    respond without recomputing chain order."""
+
+    exec_req: jnp.ndarray  # int32 [R, W, G]
+    exec_stop: jnp.ndarray  # bool [R, W, G]
+    exec_base: jnp.ndarray  # int32 [R, G]
+    exec_count: jnp.ndarray  # int32 [R, G]
+    intake_taken: jnp.ndarray  # bool [P, G]
+    head_id: jnp.ndarray  # int32 [G] (-1 if no members)
+    tail_id: jnp.ndarray  # int32 [G]
+    committed_now: jnp.ndarray  # int32 [G]
+
+
+def chain_tick_impl(state, inbox: ChainInbox):
+    R, G = state.applied.shape
+    W = state.c_req.shape[1]
+    P = inbox.req.shape[0]
+    Wm = jnp.int32(W - 1)
+    BIG = jnp.int32(1 << 30)
+
+    alive = inbox.alive
+    member = state.member
+    live_m = member & alive[:, None]  # [R, G]
+    r_idx = jnp.arange(R, dtype=I32)[:, None]  # [R, 1]
+    jw = jnp.arange(W, dtype=I32)[:, None]  # [W, 1]
+
+    # ---------------- chain topology from the member mask ----------------
+    # the head is fixed by membership (only the head may order writes), but
+    # propagation and the commit point re-link over *live* members so a dead
+    # middle/tail cannot wedge the chain (see module doc)
+    head = jnp.min(jnp.where(member, r_idx, BIG), axis=0)  # [G]
+    head = jnp.where(state.n_members > 0, head, -1).astype(I32)
+    any_live = jnp.any(live_m, axis=0)
+    tail = jnp.max(jnp.where(live_m, r_idx, -1), axis=0).astype(I32)  # [G]
+    tail = jnp.where(any_live, tail, -1)
+    # pred[r, g] = nearest live member slot below r (-1 for head/non-members)
+    preds = []
+    run = jnp.full((G,), -1, I32)
+    for r in range(R):
+        preds.append(run)
+        run = jnp.where(live_m[r], jnp.int32(r), run)
+    pred = jnp.stack(preds)  # [R, G]
+
+    def sel_r(arr_rg, idx_g):
+        """arr_rg[idx_g[g], g] per group; idx -1 -> 0."""
+        out = jnp.zeros((G,), arr_rg.dtype)
+        for r in range(R):
+            out = jnp.where(idx_g == r, arr_rg[r], out)
+        return out
+
+    is_head = (r_idx == head[None, :]) & member  # [R, G]
+    head_alive = jnp.any(is_head & alive[:, None], axis=0)  # [G]
+    head_active = sel_r(state.status, head) == int(GroupStatus.ACTIVE)
+
+    # ---------------- head intake: order new writes ----------------
+    # window room is bounded by the slowest member: a plane may only be
+    # overwritten once every member has applied it (the reference bounds the
+    # same way by unacked outstanding writes)
+    min_applied = jnp.min(
+        jnp.where(member, state.applied, BIG), axis=0
+    )  # [G]
+    min_applied = jnp.where(state.n_members > 0, min_applied, 0)
+    space = jnp.maximum(
+        jnp.int32(W) - (state.next_slot - min_applied), 0
+    )  # [G]
+    group_open = (state.n_members > 0) & head_alive & head_active
+    valid_in = (inbox.req != NO_REQUEST) & group_open[None, :]  # [P, G]
+    jp = jnp.arange(P, dtype=I32)[:, None]
+    # FIFO within the tick; truncate right after the first stop (nothing may
+    # be ordered past a stop — epoch fencing, as in the paxos intake)
+    taken_pre = valid_in & (jnp.cumsum(valid_in, axis=0) <= space[None, :])
+    stop_taken = inbox.stop & taken_pre
+    stop_before = jnp.cumsum(stop_taken.astype(I32), axis=0) - stop_taken
+    taken = taken_pre & (stop_before == 0)  # [P, G]
+    k = jnp.sum(taken, axis=0).astype(I32)  # [G]
+    # dense order of taken requests within the tick
+    ord_in = jnp.cumsum(taken.astype(I32), axis=0) - 1  # [P, G]
+    new_slot_p = state.next_slot[None, :] + ord_in  # [P, G] absolute slots
+    # scatter into the head's ring: plane i receives the taken request whose
+    # slot hashes to i
+    tgt_i = jnp.bitwise_and(new_slot_p, Wm)  # [P, G]
+    one_hot = (
+        taken[None, :, :] & (tgt_i[None, :, :] == jw[:, None, :])
+    )  # [W, P, G]
+    h_req = jnp.sum(jnp.where(one_hot, inbox.req[None], 0), axis=1)  # [W, G]
+    h_stop = jnp.any(one_hot & inbox.stop[None], axis=1)
+    h_slot = jnp.sum(jnp.where(one_hot, new_slot_p[None], 0), axis=1)
+    h_new = jnp.any(one_hot, axis=1)  # [W, G] planes written this tick
+    next_slot = state.next_slot + k
+
+    hmask = is_head[:, None, :] & h_new[None, :, :]
+    c_req = jnp.where(hmask, h_req[None], state.c_req)
+    c_slot = jnp.where(hmask, h_slot[None], state.c_slot)
+    c_stop = jnp.where(hmask, h_stop[None], state.c_stop)
+
+    # ---------------- one-hop forward propagation ----------------
+    # recv watermark: head = next_slot (owns everything it ordered);
+    # others advance to their predecessor's *previous* applied watermark
+    # (one hop per tick), but only while the predecessor is alive.
+    pred_applied = jnp.zeros((R, G), I32)
+    pred_alive = jnp.zeros((R, G), jnp.bool_)
+    for r in range(R):
+        pred_applied = pred_applied.at[r].set(sel_r(state.applied, pred[r]))
+        pred_alive = pred_alive.at[r].set(
+            sel_r(jnp.broadcast_to(alive[:, None], (R, G)), pred[r])
+        )
+    recv_hi = jnp.where(
+        is_head,
+        next_slot[None, :],
+        jnp.where(pred_alive, jnp.maximum(pred_applied, state.applied),
+                  state.applied),
+    )
+    recv_hi = jnp.where(member, recv_hi, 0)
+    # copy the predecessor's ring planes covering [applied, recv_hi):
+    # loop-select over the replica axis, plane-parallel (R is small/static)
+    pred3 = pred[:, None, :]  # [R, 1, G]
+    p_req = jnp.zeros((R, W, G), I32)
+    p_slot = jnp.full((R, W, G), -1, I32)
+    p_stop = jnp.zeros((R, W, G), jnp.bool_)
+    for rp in range(R):
+        m = (pred3 == rp)  # [R, 1, G]
+        p_req = jnp.where(m, c_req[rp][None], p_req)
+        p_slot = jnp.where(m, c_slot[rp][None], p_slot)
+        p_stop = jnp.where(m, c_stop[rp][None], p_stop)
+    want = (
+        (p_slot >= state.applied[:, None, :])
+        & (p_slot < recv_hi[:, None, :])
+        & (p_slot >= 0)
+        & member[:, None, :]
+        & ~is_head[:, None, :]
+    )
+    c_req = jnp.where(want, p_req, c_req)
+    c_slot = jnp.where(want, p_slot, c_slot)
+    c_stop = jnp.where(want, p_stop, c_stop)
+
+    # ---------------- apply ----------------
+    can_apply = member & alive[:, None] & (
+        state.status == int(GroupStatus.ACTIVE)
+    )
+    new_applied = jnp.where(can_apply, recv_hi, state.applied)
+    exec_base = state.applied
+    exec_count = jnp.clip(new_applied - exec_base, 0, W)
+    # window-ordered exec planes: plane j = slot exec_base + j
+    s_j = exec_base[:, None, :] + jw[None, :, :]  # [R, W, G]
+    i_j = jnp.bitwise_and(s_j, Wm)
+    e_req = gather_planes(c_req, i_j)
+    e_slot = gather_planes(c_slot, i_j)
+    e_stop = gather_planes(c_stop, i_j)
+    live_j = (jw[None, :, :] < exec_count[:, None, :]) & (e_slot == s_j)
+    exec_req = jnp.where(live_j, e_req, NO_REQUEST)
+    exec_stop = live_j & e_stop
+    # guard against ring mismatches (should not happen): only count planes
+    # actually present
+    exec_count = jnp.sum(live_j, axis=1).astype(I32)
+    new_applied = exec_base + exec_count
+
+    # a stop anywhere in the applied range stops this replica's chain state
+    stopped_now = jnp.any(exec_stop, axis=1)  # [R, G]
+    status = jnp.where(
+        stopped_now, jnp.int32(int(GroupStatus.STOPPED)), state.status
+    )
+
+    committed_now = sel_r(exec_count, tail)  # [G] applied at tail this tick
+    committed_now = jnp.where(state.n_members > 0, committed_now, 0)
+
+    new_state = state._replace(
+        applied=new_applied,
+        status=status,
+        c_req=c_req,
+        c_slot=c_slot,
+        c_stop=c_stop,
+        next_slot=next_slot,
+    )
+    out = ChainOutbox(
+        exec_req=exec_req,
+        exec_stop=exec_stop,
+        exec_base=exec_base,
+        exec_count=exec_count,
+        intake_taken=taken,
+        head_id=head,
+        tail_id=jnp.where(state.n_members > 0, tail, -1),
+        committed_now=committed_now,
+    )
+    return new_state, out
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def chain_tick(state, inbox: ChainInbox):
+    return chain_tick_impl(state, inbox)
+
+
+def make_inbox(n_replicas: int, n_groups: int, per_tick: int) -> ChainInbox:
+    return ChainInbox(
+        req=jnp.zeros((per_tick, n_groups), I32),
+        stop=jnp.zeros((per_tick, n_groups), jnp.bool_),
+        alive=jnp.ones((n_replicas,), jnp.bool_),
+    )

@@ -62,6 +62,7 @@ class InProcessCluster:
         wal=None,
         rc_wal=None,
         start_fd: bool = False,
+        coordinator: str = "paxos",
     ):
         self.cfg = cfg
         active_ids = cfg.nodes.active_ids()
@@ -70,10 +71,19 @@ class InProcessCluster:
             raise ValueError("topology needs >=1 active and >=1 reconfigurator")
 
         # ---------------- data plane (shared dense device state, Mode A)
-        self.manager = PaxosManager(
-            cfg, len(active_ids), [app_factory() for _ in active_ids], wal=wal
-        )
-        self.coordinator = PaxosReplicaCoordinator(self.manager, active_ids)
+        # the coordination protocol is pluggable exactly like the reference's
+        # REPLICA_COORDINATOR_CLASS (ReconfigurableNode.java:203-218)
+        apps = [app_factory() for _ in active_ids]
+        if coordinator == "chain":
+            from .chain import ChainManager, ChainReplicaCoordinator
+
+            self.manager = ChainManager(cfg, len(active_ids), apps, wal=wal)
+            self.coordinator = ChainReplicaCoordinator(self.manager, active_ids)
+        elif coordinator == "paxos":
+            self.manager = PaxosManager(cfg, len(active_ids), apps, wal=wal)
+            self.coordinator = PaxosReplicaCoordinator(self.manager, active_ids)
+        else:
+            raise ValueError(f"unknown coordinator {coordinator!r}")
         self.driver = TickDriver(self.manager).start()
 
         # ---------------- RC plane (the DB replicated on its own data plane)

@@ -25,7 +25,6 @@ axis exchange goes over the transport instead (net/, Mode B).
 from __future__ import annotations
 
 import collections
-import functools
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -37,20 +36,9 @@ from ..config import GigapaxosTpuConfig
 from ..models.replicable import Replicable
 from ..types import GroupStatus, NO_REQUEST
 from ..utils.intmap import RowAllocator
+from ..utils.locking import locked as _locked
 from . import state as st
 from ..ops.tick import TickInbox, TickOutbox, paxos_tick
-
-
-def _locked(fn):
-    """Serialize a public PaxosManager method on ``self.lock`` (reentrant, so
-    callbacks that re-enter propose from the tick thread are fine)."""
-
-    @functools.wraps(fn)
-    def wrapper(self, *a, **kw):
-        with self.lock:
-            return fn(self, *a, **kw)
-
-    return wrapper
 
 
 @dataclass

@@ -1,0 +1,23 @@
+"""Shared locking helper for the host managers.
+
+Both data-plane managers (paxos, chain) serialize their public API against
+the tick driver on a reentrant ``self.lock`` (the reference synchronizes on
+the instance map the same way, PaxosManager.java:2284-2412); this decorator
+is that convention in one place.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def locked(fn):
+    """Serialize a method on ``self.lock`` (reentrant: callbacks that
+    re-enter the manager from the tick thread are fine)."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *a, **kw):
+        with self.lock:
+            return fn(self, *a, **kw)
+
+    return wrapper

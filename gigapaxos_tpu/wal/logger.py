@@ -134,14 +134,10 @@ class PaxosLogger:
             self.checkpoint()
 
     # -------------------------------------------------------------- checkpoint
-    def checkpoint(self) -> str:
-        """Write a full snapshot and roll the journal; GC superseded files."""
-        m = self.manager
-        self.journal.sync()
-        new_seq = m.tick_num
-        path = self._snapshot_path(new_seq)
-        state_np = {f: np.asarray(getattr(m.state, f)) for f in m.state._fields}
-        meta = {
+    def _meta(self, m) -> dict:
+        """Manager-specific snapshot metadata (overridden by ChainLogger —
+        the state arrays are generic, the host bookkeeping is not)."""
+        return {
             "tick_num": m.tick_num,
             "next_rid": m._next_rid,
             "rows": dict(m.rows.items()),
@@ -158,6 +154,15 @@ class PaxosLogger:
                 for i in range(m.R)
             ],
         }
+
+    def checkpoint(self) -> str:
+        """Write a full snapshot and roll the journal; GC superseded files."""
+        m = self.manager
+        self.journal.sync()
+        new_seq = m.tick_num
+        path = self._snapshot_path(new_seq)
+        state_np = {f: np.asarray(getattr(m.state, f)) for f in m.state._fields}
+        meta = self._meta(m)
         buf = io.BytesIO()
         np.savez_compressed(buf, **state_np)
         blob = pickle.dumps((meta, buf.getvalue()))

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import collections
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 import jax.numpy as jnp
@@ -46,7 +46,7 @@ class ChainRequest:
     stop: bool
     callback: Optional[Callable[[int, bytes], None]]
     responded: bool = False
-    executed_by: int = 0
+    executed_by: set = field(default_factory=set)
 
 
 class ChainManager:
@@ -127,6 +127,15 @@ class ChainManager:
         row = self.rows.row(name)
         return row is not None and row in self._stopped_rows
 
+    @_locked
+    def exec_watermarks(self, name: str):
+        """Per-replica applied watermark [R] (donor selection for
+        checkpoint transfer — see PaxosManager.exec_watermarks)."""
+        row = self.rows.row(name)
+        if row is None:
+            return None
+        return np.array(self.state.applied[:, row])
+
     # ---------------------------------------------------------------- propose
     @_locked
     def propose(
@@ -199,6 +208,8 @@ class ChainManager:
         if self.wal is not None:
             self.wal.maybe_checkpoint()
         self._flush_callbacks()
+        if self.tick_num % 64 == 0:
+            self._sweep_outstanding()
         return out
 
     def _flush_callbacks(self) -> None:
@@ -249,7 +260,7 @@ class ChainManager:
             self.stats["orphan_execs"] += 1
             return
         response = self.apps[r].execute(name, rec.payload, rid)
-        rec.executed_by += 1
+        rec.executed_by.add(r)
         self.stats["executions"] += 1
         if at_tail and not rec.responded:
             # commit point: the tail applied it (every upstream member has
@@ -258,8 +269,29 @@ class ChainManager:
             if rec.callback is not None:
                 self._held_callbacks.append((rec.callback, rid, response))
         members = int(self.state.n_members[row])
-        if rec.responded and rec.executed_by >= members:
+        if rec.responded and len(rec.executed_by) >= members:
             del self.outstanding[rid]
+
+    def _sweep_outstanding(self) -> None:
+        """Drop responded records every *live* member has executed — with a
+        dead member, executed_by can never cover the full membership, and
+        without this sweep every request payload is retained forever (the
+        paxos manager sweeps identically; dead members catch up from the
+        ring or by checkpoint transfer, not from the host payload store)."""
+        if not self.outstanding:
+            return
+        member = np.array(self.state.member)
+        dead = []
+        for rid, rec in self.outstanding.items():
+            if not rec.responded:
+                continue
+            ms = np.where(member[:, rec.row])[0]
+            live = [m for m in ms if self.alive[m]]
+            if live and all(m in rec.executed_by for m in live):
+                dead.append(rid)
+        for rid in dead:
+            del self.outstanding[rid]
+            self.stats["swept"] += 1
 
     # --------------------------------------------------------------- liveness
     def set_alive(self, r: int, up: bool) -> None:

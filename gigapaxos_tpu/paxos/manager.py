@@ -148,6 +148,16 @@ class PaxosManager:
         row = self.rows.row(name)
         return row is not None and row in self._stopped_rows
 
+    @_locked
+    def exec_watermarks(self, name: str) -> Optional[np.ndarray]:
+        """Per-replica-slot execution watermark for the group ([R] int), the
+        donor-selection signal for checkpoint transfer: only a replica at
+        the group maximum holds the complete (e.g. epoch-final) state."""
+        row = self.rows.row(name)
+        if row is None:
+            return None
+        return np.array(self.state.exec_slot[:, row])
+
     # ---------------------------------------------------------------- propose
     @_locked
     def propose(

@@ -174,10 +174,17 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
         members = self.manager.group_members(pname)
         if not members:
             return None
-        # any caught-up live member's app state is the epoch-final state
-        # (the stop is the last executed request by construction)
+        # The donor must be a member at the group's maximum execution
+        # watermark: a just-revived laggard is alive but holds pre-stop
+        # state, and checkpointing it would seed the next epoch with lost
+        # writes.  If only dead members hold the final state, return None
+        # and let the fetch task retry (WaitEpochFinalState).
+        marks = self.manager.exec_watermarks(pname)
+        if marks is None:
+            return None
+        final = max(marks[s] for s in members)
         for s in members:
-            if self.manager.alive[s]:
+            if self.manager.alive[s] and marks[s] == final:
                 return self.manager.apps[s].checkpoint(pname)
         return None
 

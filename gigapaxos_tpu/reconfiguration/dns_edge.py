@@ -1,0 +1,147 @@
+"""DNS front-end: serve A records for service names.
+
+Analog of ``reconfiguration/dns/DnsReconfigurator.java`` (247 LoC): a UDP
+DNS server that answers ``A`` queries for service names with the addresses
+of the name's current active replicas, with a pluggable traffic policy
+deciding which/in what order (``DnsTrafficPolicy`` analog).
+
+Minimal RFC1035 subset, stdlib-only: one question per query, A/IN answers,
+NXDOMAIN for unknown names.  The zone suffix (e.g. ``.gp``) is stripped
+before resolution so ``alice.gp`` resolves service name ``alice``.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from ..client import ClientError, ReconfigurableAppClient
+
+#: policy(name, actives, addrs) -> ordered list of IPv4 strings to serve
+DnsTrafficPolicy = Callable[[str, List[str], dict], List[str]]
+
+
+def default_policy(name: str, actives: List[str], addrs: dict) -> List[str]:
+    """All actives, rotated by the name hash (coarse load spreading)."""
+    ips = [addrs[a][0] for a in actives if a in addrs]
+    if not ips:
+        return []
+    k = hash(name) % len(ips)
+    return ips[k:] + ips[:k]
+
+
+class DnsReconfigurator:
+    def __init__(
+        self,
+        client: ReconfigurableAppClient,
+        bind: Tuple[str, int] = ("127.0.0.1", 0),
+        zone: str = "gp",
+        ttl: int = 30,
+        policy: DnsTrafficPolicy = default_policy,
+    ):
+        self.client = client
+        self.zone = zone.strip(".")
+        self.ttl = ttl
+        self.policy = policy
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind(bind)
+        self.sock.settimeout(0.25)
+        self.port = self.sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._serve, name=f"dns-{self.port}", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+        self.sock.close()
+
+    # ------------------------------------------------------------------ serve
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                data, addr = self.sock.recvfrom(512)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            # per-query worker: a cache-miss resolve is a synchronous RC
+            # round trip, and one slow name must not stall every other
+            # resolver (the client's actives cache keeps the hot path local)
+            threading.Thread(
+                target=self._handle_one, args=(data, addr), daemon=True
+            ).start()
+
+    def _handle_one(self, data: bytes, addr) -> None:
+        try:
+            resp = self._answer(data)
+        except Exception:
+            return  # malformed query: drop
+        if resp is not None:
+            try:
+                self.sock.sendto(resp, addr)
+            except OSError:
+                pass
+
+    def _resolve(self, qname: str) -> Optional[List[str]]:
+        name = qname.rstrip(".")
+        if self.zone and name.endswith("." + self.zone):
+            name = name[: -len(self.zone) - 1]
+        try:
+            actives = self.client.request_actives(name)
+        except (ClientError, TimeoutError):
+            return None
+        # the actives response already taught the client's nodemap the addrs
+        addrs = {
+            a: list(self.client.nodemap(a)) for a in actives
+            if self.client.nodemap(a) is not None
+        }
+        return self.policy(name, actives, addrs)
+
+    def _answer(self, q: bytes) -> Optional[bytes]:
+        if len(q) < 12:
+            return None
+        (tid, flags, qd, _an, _ns, _ar) = struct.unpack(">HHHHHH", q[:12])
+        if qd != 1:
+            return None
+        # parse QNAME labels
+        off = 12
+        labels = []
+        while True:
+            ln = q[off]
+            off += 1
+            if ln == 0:
+                break
+            labels.append(q[off: off + ln].decode("ascii", "replace"))
+            off += ln
+        qtype, qclass = struct.unpack(">HH", q[off: off + 4])
+        off += 4
+        question = q[12:off]
+        qname = ".".join(labels)
+        if qclass != 1:
+            hdr = struct.pack(">HHHHHH", tid, 0x8404, 1, 0, 0, 0)  # NOTIMP
+            return hdr + question
+        ips = self._resolve(qname)
+        if ips is None:
+            # unknown name: NXDOMAIN, authoritative
+            hdr = struct.pack(">HHHHHH", tid, 0x8403, 1, 0, 0, 0)
+            return hdr + question
+        if qtype not in (1, 255) or not ips:
+            # the name exists but has no records of this type (e.g. AAAA):
+            # NOERROR with zero answers — NXDOMAIN here would let resolvers
+            # negative-cache the whole name and kill the parallel A lookup
+            hdr = struct.pack(">HHHHHH", tid, 0x8400, 1, 0, 0, 0)
+            return hdr + question
+        answers = b""
+        for ip in ips:
+            answers += (
+                b"\xc0\x0c"  # pointer to QNAME at offset 12
+                + struct.pack(">HHIH", 1, 1, self.ttl, 4)
+                + socket.inet_aton(ip)
+            )
+        hdr = struct.pack(">HHHHHH", tid, 0x8400, 1, len(ips), 0, 0)
+        return hdr + question + answers

@@ -11,14 +11,12 @@ infrastructure as paxos groups (``ChainManager`` reuses
 from __future__ import annotations
 
 import collections
-import glob
 import io
-import os
 import pickle
 
 import numpy as np
 
-from .logger import OP_CREATE, OP_REMOVE, OP_TICK, PaxosLogger
+from .logger import PaxosLogger, replay_journals
 
 
 class ChainLogger(PaxosLogger):
@@ -29,8 +27,8 @@ class ChainLogger(PaxosLogger):
             "rows": dict(m.rows.items()),
             "stopped_rows": set(m._stopped_rows),
             "outstanding": [
-                (r.rid, r.name, r.row, r.payload, r.stop, r.executed_by,
-                 r.responded)
+                (r.rid, r.name, r.row, r.payload, r.stop,
+                 sorted(r.executed_by), r.responded)
                 for r in m.outstanding.values()
             ],
             "queues": {row: list(q) for row, q in m._queues.items() if q},
@@ -49,7 +47,6 @@ def recover_chain(cfg, n_replicas: int, apps, log_dir: str, native: bool = True)
     from ..chain.manager import ChainManager, ChainRequest
     from ..chain.state import ChainState
     from ..chain.tick import ChainInbox, chain_tick
-    from .journal import read_journal
 
     logger = ChainLogger(log_dir, native=native)
     m = ChainManager(cfg, n_replicas, apps)
@@ -71,7 +68,7 @@ def recover_chain(cfg, n_replicas: int, apps, log_dir: str, native: bool = True)
         m._stopped_rows = set(meta["stopped_rows"])
         for rid, name, row, payload, stop, eby, responded in meta["outstanding"]:
             m.outstanding[rid] = ChainRequest(
-                rid, name, row, payload, stop, None, responded, eby
+                rid, name, row, payload, stop, None, responded, set(eby)
             )
         for row, rids in meta["queues"].items():
             m._queues[int(row)] = collections.deque(rids)
@@ -80,52 +77,23 @@ def recover_chain(cfg, n_replicas: int, apps, log_dir: str, native: bool = True)
                 m.apps[i].restore(name, blob)
         start_seq = snap_seq
 
-    for path in sorted(glob.glob(os.path.join(log_dir, "journal.*.log"))):
-        seq = int(os.path.basename(path).split(".")[1])
-        if seq < start_seq:
-            continue
-        for raw in read_journal(path):
-            rec = pickle.loads(raw)
-            op = rec[0]
-            if op == OP_CREATE:
-                _, name, members, epoch = rec
-                if name not in m.rows:
-                    m.create_paxos_instance(name, members, epoch)
-            elif op == OP_REMOVE:
-                m.remove_paxos_instance(rec[1])
-            elif op == OP_TICK:
-                _, tick_num, placed, alive_b = rec
-                if tick_num < m.tick_num:
-                    continue  # covered by the snapshot
-                req = np.zeros((m.P, m.G), np.int32)
-                stp = np.zeros((m.P, m.G), bool)
-                m._placed = []
-                for row, entries in placed:
-                    take = []
-                    placed_rids = set()
-                    for rid, _entry, p, payload, stop in entries:
-                        m._next_rid = max(m._next_rid, rid + 1)
-                        placed_rids.add(rid)
-                        if rid not in m.outstanding:
-                            m.outstanding[rid] = ChainRequest(
-                                rid, m.rows.name(row) or "?", row, payload, stop,
-                                None,
-                            )
-                        req[p, row] = rid
-                        stp[p, row] = stop
-                        take.append((rid, _entry, p))
-                    m._placed.append((row, take))
-                    if row in m._queues and placed_rids:
-                        m._queues[row] = collections.deque(
-                            r for r in m._queues[row] if r not in placed_rids
-                        )
-                alive = np.frombuffer(alive_b, dtype=bool)
-                ib = ChainInbox(
-                    jnp.asarray(req), jnp.asarray(stp), jnp.asarray(alive)
-                )
-                m.state, out = chain_tick(m.state, ib)
-                m._process_outbox(out)
-                m.tick_num = tick_num + 1
+    def make_record(m, rid, row, payload, stop, entry):
+        return ChainRequest(rid, m.rows.name(row) or "?", row, payload, stop,
+                            None)
+
+    def new_buffers(m):
+        return (np.zeros((m.P, m.G), np.int32), np.zeros((m.P, m.G), bool))
+
+    def place(bufs, entry, p, row, rid, stop):
+        bufs[0][p, row] = rid
+        bufs[1][p, row] = stop
+
+    def build_inbox(bufs, alive):
+        return ChainInbox(jnp.asarray(bufs[0]), jnp.asarray(bufs[1]),
+                          jnp.asarray(alive))
+
+    replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
+                    build_inbox, chain_tick)
     logger.attach(m)
     m.wal = logger
     return m

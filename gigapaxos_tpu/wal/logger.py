@@ -194,6 +194,67 @@ class PaxosLogger:
 
 
 # ------------------------------------------------------------------ recovery
+def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
+                    build_inbox, tick_fn):
+    """Shared journal-replay loop (passes 2–3 of recovery) for any manager.
+
+    The protocol-specific parts are injected: ``make_record`` builds the
+    outstanding-request record, ``new_buffers``/``place``/``build_inbox``
+    shape the tick's inbox, ``tick_fn`` runs the device step.  Everything
+    else — create/remove replay, snapshot-boundary skip, placed-rid dedup
+    against snapshot queues (without which a request queued in the snapshot
+    and placed in the journal would commit twice), rid-counter repair — is
+    identical across protocols and lives here once.
+    """
+    import collections
+
+    from .journal import read_journal
+
+    for path in sorted(glob.glob(os.path.join(log_dir, "journal.*.log"))):
+        seq = int(os.path.basename(path).split(".")[1])
+        if seq < start_seq:
+            continue
+        for raw in read_journal(path):
+            rec = pickle.loads(raw)
+            op = rec[0]
+            if op == OP_CREATE:
+                _, name, members, epoch = rec
+                if name not in m.rows:
+                    m.create_paxos_instance(name, members, epoch)
+            elif op == OP_REMOVE:
+                m.remove_paxos_instance(rec[1])
+            elif op == OP_TICK:
+                _, tick_num, placed, alive_b = rec
+                if tick_num < m.tick_num:
+                    continue  # already inside the snapshot
+                bufs = new_buffers(m)
+                m._placed = []
+                for row, entries in placed:
+                    take = []
+                    placed_rids = set()
+                    for rid, entry, p, payload, stop in entries:
+                        m._next_rid = max(m._next_rid, rid + 1)
+                        placed_rids.add(rid)
+                        if rid not in m.outstanding:
+                            m.outstanding[rid] = make_record(
+                                m, rid, row, payload, stop, entry
+                            )
+                        place(bufs, entry, p, row, rid, stop)
+                        take.append((rid, entry, p))
+                    m._placed.append((row, take))
+                    # a snapshot may hold queue copies of requests whose
+                    # placement is journaled after it; drop them or they
+                    # would be proposed (and committed) a second time
+                    if row in m._queues and placed_rids:
+                        m._queues[row] = collections.deque(
+                            r for r in m._queues[row] if r not in placed_rids
+                        )
+                alive = np.frombuffer(alive_b, dtype=bool)
+                m.state, out = tick_fn(m.state, build_inbox(bufs, alive))
+                m._process_outbox(out)
+                m.tick_num = tick_num + 1
+
+
 def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True):
     """Rebuild a PaxosManager from disk: snapshot + deterministic tick replay
     (the analog of the reference's 3-pass recovery,
@@ -239,56 +300,24 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True):
                 m.apps[i].restore(name, blob)
         start_seq = snap_seq
 
-    # replay journals >= start_seq in order
-    paths = sorted(glob.glob(os.path.join(log_dir, "journal.*.log")))
-    for path in paths:
-        seq = int(os.path.basename(path).split(".")[1])
-        if seq < start_seq:
-            continue
-        for raw in read_journal(path):
-            rec = pickle.loads(raw)
-            op = rec[0]
-            if op == OP_CREATE:
-                _, name, members, epoch = rec
-                if name not in m.rows:
-                    m.create_paxos_instance(name, members, epoch)
-            elif op == OP_REMOVE:
-                _, name = rec
-                m.remove_paxos_instance(name)
-            elif op == OP_TICK:
-                _, tick_num, placed, alive_b = rec
-                if tick_num < m.tick_num:
-                    continue  # already inside the snapshot
-                req = np.zeros((m.R, m.P, m.G), np.int32)
-                stp = np.zeros((m.R, m.P, m.G), bool)
-                m._placed = []
-                for row, entries in placed:
-                    take = []
-                    placed_rids = set()
-                    for rid, entry, p, payload, stop in entries:
-                        m._next_rid = max(m._next_rid, rid + 1)
-                        placed_rids.add(rid)
-                        if rid not in m.outstanding:
-                            m.outstanding[rid] = RequestRecord(
-                                rid, m.rows.name(row) or "?", row, payload,
-                                stop, None, entry
-                            )
-                        req[entry, p, row] = rid
-                        stp[entry, p, row] = stop
-                        take.append((rid, entry, p))
-                    m._placed.append((row, take))
-                    # a snapshot may hold queue copies of requests whose
-                    # placement is journaled after it; drop them or they
-                    # would be proposed (and committed) a second time
-                    if row in m._queues and placed_rids:
-                        m._queues[row] = type(m._queues[row])(
-                            r for r in m._queues[row] if r not in placed_rids
-                        )
-                alive = np.frombuffer(alive_b, dtype=bool)
-                ib = TickInbox(jnp.asarray(req), jnp.asarray(stp), jnp.asarray(alive))
-                m.state, out = paxos_tick(m.state, ib)
-                m._process_outbox(out)
-                m.tick_num = tick_num + 1
+    def make_record(m, rid, row, payload, stop, entry):
+        return RequestRecord(rid, m.rows.name(row) or "?", row, payload,
+                             stop, None, entry)
+
+    def new_buffers(m):
+        return (np.zeros((m.R, m.P, m.G), np.int32),
+                np.zeros((m.R, m.P, m.G), bool))
+
+    def place(bufs, entry, p, row, rid, stop):
+        bufs[0][entry, p, row] = rid
+        bufs[1][entry, p, row] = stop
+
+    def build_inbox(bufs, alive):
+        return TickInbox(jnp.asarray(bufs[0]), jnp.asarray(bufs[1]),
+                         jnp.asarray(alive))
+
+    replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
+                    build_inbox, paxos_tick)
     # reattach logging
     logger.attach(m)
     m.wal = logger

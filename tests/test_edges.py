@@ -1,0 +1,117 @@
+"""HTTP and DNS front-end tests (reference: docs/HTTP-API.md dialect,
+reconfiguration/dns/DnsReconfigurator.java)."""
+
+import json
+import socket
+import struct
+import urllib.request
+
+import pytest
+
+from gigapaxos_tpu.client import ReconfigurableAppClient
+from gigapaxos_tpu.config import GigapaxosTpuConfig
+from gigapaxos_tpu.models.replicable import KVApp
+from gigapaxos_tpu.node import InProcessCluster
+from gigapaxos_tpu.reconfiguration.dns_edge import DnsReconfigurator
+from gigapaxos_tpu.reconfiguration.http_edge import (
+    HttpActiveReplica,
+    HttpReconfigurator,
+)
+
+
+@pytest.fixture(scope="module")
+def stack():
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 64
+    for i in range(3):
+        cfg.nodes.actives[f"AR{i}"] = ("127.0.0.1", 0)
+    for i in range(3):
+        cfg.nodes.reconfigurators[f"RC{i}"] = ("127.0.0.1", 0)
+    cl = InProcessCluster(cfg, KVApp)
+    client = ReconfigurableAppClient(cfg.nodes)
+    rc_http = HttpReconfigurator(client, ("127.0.0.1", 0))
+    ar_http = HttpActiveReplica(client, ("127.0.0.1", 0))
+    dns = DnsReconfigurator(client, ("127.0.0.1", 0))
+    yield cl, client, rc_http, ar_http, dns
+    dns.close()
+    rc_http.close()
+    ar_http.close()
+    client.close()
+    cl.close()
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30
+        ) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def test_http_create_request_delete(stack):
+    _, _, rc_http, ar_http, _ = stack
+    code, resp = _get(rc_http.port, "/?type=CREATE&name=Alice")
+    assert code == 200 and not resp["FAILED"]
+    code, resp = _get(ar_http.port, "/?name=Alice&qval=PUT%20k%20v1")
+    assert code == 200 and resp["RVAL"] == "OK"
+    code, resp = _get(ar_http.port, "/?name=Alice&qval=GET%20k")
+    assert resp["RVAL"] == "v1" and resp["NAME"] == "Alice"
+    code, resp = _get(rc_http.port, "/?type=REQ_ACTIVES&name=Alice")
+    assert code == 200 and len(resp["ACTIVES"]) == 3
+    code, resp = _get(rc_http.port, "/?type=DELETE&name=Alice")
+    assert code == 200 and not resp["FAILED"]
+    code, resp = _get(rc_http.port, "/?type=REQ_ACTIVES&name=Alice")
+    assert code == 404
+
+
+def test_http_bad_request(stack):
+    _, _, rc_http, ar_http, _ = stack
+    try:
+        code, _ = _get(rc_http.port, "/?type=CREATE")  # missing name
+    except urllib.error.HTTPError as e:
+        code = e.code
+    assert code == 400
+
+
+def _dns_query(port, qname):
+    q = struct.pack(">HHHHHH", 0x1234, 0x0100, 1, 0, 0, 0)
+    for label in qname.split("."):
+        q += bytes([len(label)]) + label.encode()
+    q += b"\x00" + struct.pack(">HH", 1, 1)  # A, IN
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.settimeout(30)
+    s.sendto(q, ("127.0.0.1", port))
+    data, _ = s.recvfrom(512)
+    s.close()
+    tid, flags, qd, an, ns, ar = struct.unpack(">HHHHHH", data[:12])
+    ips = []
+    # skip question
+    off = 12
+    while data[off]:
+        off += 1 + data[off]
+    off += 5
+    for _ in range(an):
+        off += 2  # name pointer
+        rtype, rclass, ttl, rdlen = struct.unpack(">HHIH", data[off: off + 10])
+        off += 10
+        ips.append(socket.inet_ntoa(data[off: off + rdlen]))
+        off += rdlen
+    return flags, ips
+
+
+def test_dns_resolves_actives(stack):
+    _, client, _, _, dns = stack
+    assert client.create("web")["ok"]
+    flags, ips = _dns_query(dns.port, "web.gp")
+    assert flags & 0x8000  # response bit
+    assert (flags & 0x000F) == 0  # NOERROR
+    assert len(ips) == 3 and all(ip == "127.0.0.1" for ip in ips)
+
+
+def test_dns_nxdomain(stack):
+    _, _, _, _, dns = stack
+    flags, ips = _dns_query(dns.port, "nosuch.gp")
+    assert (flags & 0x000F) == 3  # NXDOMAIN
+    assert ips == []

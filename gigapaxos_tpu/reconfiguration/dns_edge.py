@@ -15,7 +15,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple  # noqa: F401
 
 from ..client import ClientError, ReconfigurableAppClient
 
@@ -87,20 +87,34 @@ class DnsReconfigurator:
             except OSError:
                 pass
 
-    def _resolve(self, qname: str) -> Optional[List[str]]:
+    def _resolve(self, qname: str) -> Tuple[str, Optional[List[str]]]:
+        """-> ("ok", ips) | ("nxdomain", None) | ("servfail", None).
+
+        A transient RC failure must NOT be answered NXDOMAIN: resolvers
+        negative-cache nonexistence and would blackhole a healthy name."""
         name = qname.rstrip(".")
         if self.zone and name.endswith("." + self.zone):
             name = name[: -len(self.zone) - 1]
         try:
             actives = self.client.request_actives(name)
-        except (ClientError, TimeoutError):
-            return None
+        except ClientError:
+            return "nxdomain", None  # authoritative: the name does not exist
+        except TimeoutError:
+            return "servfail", None  # transient: let the resolver retry
         # the actives response already taught the client's nodemap the addrs
         addrs = {
             a: list(self.client.nodemap(a)) for a in actives
             if self.client.nodemap(a) is not None
         }
-        return self.policy(name, actives, addrs)
+        ips = []
+        for ip in self.policy(name, actives, addrs):
+            # topology may name hosts ('localhost', 'node1.internal');
+            # A records need dotted quads
+            try:
+                ips.append(socket.gethostbyname(ip))
+            except OSError:
+                continue
+        return "ok", ips
 
     def _answer(self, q: bytes) -> Optional[bytes]:
         if len(q) < 12:
@@ -125,8 +139,11 @@ class DnsReconfigurator:
         if qclass != 1:
             hdr = struct.pack(">HHHHHH", tid, 0x8404, 1, 0, 0, 0)  # NOTIMP
             return hdr + question
-        ips = self._resolve(qname)
-        if ips is None:
+        status, ips = self._resolve(qname)
+        if status == "servfail":
+            hdr = struct.pack(">HHHHHH", tid, 0x8402, 1, 0, 0, 0)
+            return hdr + question
+        if status == "nxdomain":
             # unknown name: NXDOMAIN, authoritative
             hdr = struct.pack(">HHHHHH", tid, 0x8403, 1, 0, 0, 0)
             return hdr + question

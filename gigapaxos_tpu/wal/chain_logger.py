@@ -67,8 +67,13 @@ def recover_chain(cfg, n_replicas: int, apps, log_dir: str, native: bool = True)
             m.rows._free.remove(row)
         m._stopped_rows = set(meta["stopped_rows"])
         for rid, name, row, payload, stop, eby, responded in meta["outstanding"]:
+            # executed_by was an int count in snapshots written before it
+            # became a replica-index set; those carry no index information,
+            # so restore conservatively as empty (the record is merely
+            # retained longer until the sweep re-covers it)
+            eby_set = set(eby) if isinstance(eby, (list, tuple, set)) else set()
             m.outstanding[rid] = ChainRequest(
-                rid, name, row, payload, stop, None, responded, set(eby)
+                rid, name, row, payload, stop, None, responded, eby_set
             )
         for row, rids in meta["queues"].items():
             m._queues[int(row)] = collections.deque(rids)

@@ -1,0 +1,123 @@
+"""Transaction layer tests (src/edu/umass/cs/txn analog, SURVEY §2.5).
+
+Atomicity across names, lock conflict serialization, deadlock freedom via
+global lock order, and lock blocking of plain requests.
+"""
+
+import threading
+
+import pytest
+
+from gigapaxos_tpu.config import GigapaxosTpuConfig
+from gigapaxos_tpu.models.replicable import KVApp
+from gigapaxos_tpu.paxos.manager import PaxosManager
+from gigapaxos_tpu.paxos.driver import TickDriver
+from gigapaxos_tpu.txn import DistTransactor, TxApp, TX_LOCKED
+
+
+@pytest.fixture()
+def plane():
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 16
+    mgr = PaxosManager(cfg, 3, [TxApp(KVApp()) for _ in range(3)])
+    for name in ("acct", "bank", "log"):
+        mgr.create_paxos_instance(name, [0, 1, 2])
+    driver = TickDriver(mgr).start()
+    driver.wait_ready()
+
+    def coordinate(name, payload, cb):
+        r = mgr.propose(name, payload, cb)
+        driver.kick()
+        return r
+
+    yield mgr, coordinate
+    driver.stop()
+
+
+def test_commit_across_names(plane):
+    mgr, coordinate = plane
+    tx = DistTransactor(coordinate)
+    res = tx.transact([
+        ("acct", b"PUT alice 100"),
+        ("bank", b"PUT total 100"),
+        ("log", b"PUT last credit"),
+    ]).wait()
+    assert res.committed and not res.aborted
+    assert res.results == [b"OK", b"OK", b"OK"]
+    assert res.result_for("acct") == b"OK"
+    # all replicas see it, locks fully released
+    for app in mgr.apps:
+        assert app.app.db["acct"]["alice"] == "100"
+        assert app.locks == {}
+
+
+def test_conflicting_txns_serialize(plane):
+    mgr, coordinate = plane
+    tx = DistTransactor(coordinate, retry_delay_s=0.02)
+    results = []
+    def run(i):
+        r = tx.transact([
+            ("acct", f"PUT ctr {i}".encode()),
+            ("bank", f"PUT ctr {i}".encode()),
+        ]).wait()
+        results.append(r)
+    ts = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+    [t.start() for t in ts]
+    [t.join(timeout=60) for t in ts]
+    assert len(results) == 4 and all(r.committed for r in results)
+    # both names ended on the SAME value (atomicity under contention)
+    a = mgr.apps[0].app.db["acct"]["ctr"]
+    b = mgr.apps[0].app.db["bank"]["ctr"]
+    assert a == b
+    assert mgr.apps[0].locks == {}
+
+
+def test_lock_blocks_plain_requests(plane):
+    mgr, coordinate = plane
+    from gigapaxos_tpu.txn import tx_payload
+    got = {}
+    ev = threading.Event()
+    coordinate("acct", tx_payload("lock", "heldtx"),
+               lambda rid, r: (got.update({"lock": r}), ev.set()))
+    assert ev.wait(20) and got["lock"] == b"TX_OK"
+    ev2 = threading.Event()
+    coordinate("acct", b"PUT x 1", lambda rid, r: (got.update({"put": r}), ev2.set()))
+    assert ev2.wait(20) and got["put"] == TX_LOCKED
+    ev3 = threading.Event()
+    coordinate("acct", tx_payload("unlock", "heldtx"),
+               lambda rid, r: ev3.set())
+    assert ev3.wait(20)
+    ev4 = threading.Event()
+    coordinate("acct", b"PUT x 1", lambda rid, r: (got.update({"put2": r}), ev4.set()))
+    assert ev4.wait(20) and got["put2"] == b"OK"
+
+
+def test_abort_on_unknown_name(plane):
+    mgr, coordinate = plane
+    tx = DistTransactor(coordinate, max_lock_retries=2, retry_delay_s=0.01)
+    res = tx.transact([
+        ("acct", b"PUT a 1"),
+        ("nosuch", b"PUT b 2"),
+    ]).wait()
+    assert res.aborted and not res.committed
+    # the lock acquired on acct was released on abort
+    assert mgr.apps[0].locks == {}
+    # and acct's op never executed
+    assert "a" not in mgr.apps[0].app.db.get("acct", {})
+
+
+def test_txapp_checkpoint_carries_lock(plane):
+    """Lock state must survive checkpoint transfer (epoch change mid-tx)."""
+    app = TxApp(KVApp())
+    app.execute("n", b"PUT k v", 1)
+    from gigapaxos_tpu.txn import tx_payload
+    assert app.execute("n", tx_payload("lock", "t1"), 2) == b"TX_OK"
+    blob = app.checkpoint("n")
+    fresh = TxApp(KVApp())
+    fresh.restore("n", blob)
+    assert fresh.locks["n"] == "t1"
+    assert fresh.app.db["n"]["k"] == "v"
+    # plain checkpoint (no lock) round-trips without the TX envelope
+    app.execute("n", tx_payload("unlock", "t1"), 3)
+    blob2 = app.checkpoint("n")
+    assert not blob2.startswith(b"\x01TX\x01")

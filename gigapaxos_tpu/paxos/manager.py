@@ -91,6 +91,11 @@ class PaxosManager:
         self._seen_cap = 8 * self.W
         self.stats = collections.Counter()
         self._stopped_rows: set[int] = set()
+        # ---- pause/spill (deactivation, PaxosManager.java:2284-2412) ----
+        # name -> HotRestoreInfo dict (+ "stopped" flag); device row freed
+        self._paused: Dict[str, dict] = {}
+        self._last_active = np.zeros(self.G, np.int64)
+        self._row_outstanding = collections.Counter()
         # Control-plane threads (messenger readers, protocol tasks) call the
         # admin/propose API while a tick driver loops on tick(); one reentrant
         # lock serializes them (the reference synchronizes on the instance map
@@ -105,9 +110,11 @@ class PaxosManager:
         self, name: str, members: List[int], epoch: int = 0
     ) -> bool:
         """createPaxosInstance analog (PaxosManager.java:611)."""
-        if name in self.rows:
+        if name in self.rows or name in self._paused:
             return False
-        row = self.rows.alloc(name)
+        row = self._alloc_row(name)
+        if row is None:
+            return False
         mask = np.zeros((1, self.R), bool)
         for m in members:
             mask[0, m] = True
@@ -118,6 +125,7 @@ class PaxosManager:
             np.array([epoch], np.int32),
         )
         self._stopped_rows.discard(row)
+        self._last_active[row] = self.tick_num
         if self.wal is not None:
             self.wal.log_create(name, members, epoch)
         return True
@@ -125,12 +133,18 @@ class PaxosManager:
     @_locked
     def remove_paxos_instance(self, name: str) -> bool:
         """kill/cremation analog (PaxosManager.java:2162-2205)."""
+        if name in self._paused:
+            del self._paused[name]
+            if self.wal is not None:
+                self.wal.log_remove(name)
+            return True
         row = self.rows.row(name)
         if row is None:
             return False
         self.state = st.free_groups(self.state, np.array([row], np.int32))
         self.rows.free(name)
         self._fail_queued(row)
+        self._purge_row_outstanding(row)
         self._stopped_rows.discard(row)
         if self.wal is not None:
             self.wal.log_remove(name)
@@ -138,6 +152,9 @@ class PaxosManager:
 
     @_locked
     def group_members(self, name: str) -> Optional[List[int]]:
+        if name in self._paused:
+            hri = self._paused[name]
+            return [int(r) for r in np.where(hri["member"])[0]]
         row = self.rows.row(name)
         if row is None:
             return None
@@ -145,6 +162,8 @@ class PaxosManager:
 
     @_locked
     def is_stopped(self, name: str) -> bool:
+        if name in self._paused:
+            return bool(self._paused[name].get("stopped"))
         row = self.rows.row(name)
         return row is not None and row in self._stopped_rows
 
@@ -153,10 +172,116 @@ class PaxosManager:
         """Per-replica-slot execution watermark for the group ([R] int), the
         donor-selection signal for checkpoint transfer: only a replica at
         the group maximum holds the complete (e.g. epoch-final) state."""
+        if name in self._paused:
+            return np.array(self._paused[name]["exec_slot"])
         row = self.rows.row(name)
         if row is None:
             return None
         return np.array(self.state.exec_slot[:, row])
+
+    # ------------------------------------------------------------ pause/spill
+    def _resident_row(self, name: str) -> Optional[int]:
+        """Row of ``name``, transparently unpausing a spilled group
+        (getInstance -> unpause, PaxosManager.java:2370-2412)."""
+        row = self.rows.row(name)
+        if row is not None:
+            return row
+        if name in self._paused:
+            return self._unpause(name)
+        return None
+
+    def _alloc_row(self, name: str) -> Optional[int]:
+        """Row allocation with eviction under pressure: a full table
+        force-pauses the coldest quiescent group to make room."""
+        if self.rows.full():
+            evicted = self._pause_eligible(limit=1, ignore_idle=True)
+            if not evicted:
+                return None  # every row is hot — table genuinely full
+        return self.rows.alloc(name)
+
+    @_locked
+    def pause_idle(self, limit: int = 64) -> int:
+        """Deactivator analog (PaxosManager.java:2951, period
+        PC.DEACTIVATION_PERIOD): spill groups idle for
+        ``deactivation_ticks``.  Returns the number paused."""
+        return len(self._pause_eligible(limit=limit, ignore_idle=False))
+
+    def _pause_eligible(self, limit: int, ignore_idle: bool) -> List[str]:
+        idle_after = 0 if ignore_idle else self.cfg.paxos.deactivation_ticks
+        exec_slot = np.array(self.state.exec_slot)
+        next_slot = np.array(self.state.next_slot)
+        member = np.array(self.state.member)
+        # coldest first so eviction keeps the working set hot
+        cands = sorted(
+            self.rows.items(), key=lambda kv: self._last_active[kv[1]]
+        )
+        paused: List[str] = []
+        for name, row in cands:
+            if len(paused) >= limit:
+                break
+            if self.tick_num - self._last_active[row] < idle_after:
+                if not ignore_idle:
+                    break  # sorted: everything later is hotter
+                continue
+            if self._queues.get(row) or self._row_outstanding[row] > 0:
+                continue
+            ms = np.where(member[:, row])[0]
+            if len(ms) == 0:
+                continue
+            ex = exec_slot[ms, row]
+            # quiescent = every member executed everything anyone assigned
+            if ex.min() != ex.max() or next_slot[ms, row].max() > ex.min():
+                continue
+            paused.append(name)
+        if paused:
+            self._do_pause(paused)
+            if self.wal is not None:
+                self.wal.log_pause(paused)
+        return paused
+
+    def _do_pause(self, names: List[str]) -> None:
+        """Spill exactly ``names`` (selection already done — also the WAL
+        replay entry point, which must mirror the original run's choice so
+        row allocation stays in lockstep)."""
+        rows_to_free = []
+        for name in names:
+            row = self.rows.row(name)
+            hri = st.extract_hri(self.state, row)
+            hri["stopped"] = row in self._stopped_rows
+            self._paused[name] = hri
+            rows_to_free.append(row)
+        self.state = st.free_groups(self.state, np.array(rows_to_free, np.int32))
+        for name in names:
+            row = self.rows.free(name)
+            self._stopped_rows.discard(row)
+            self._queues.pop(row, None)
+        self.stats["paused"] += len(names)
+
+    def _unpause(self, name: str) -> Optional[int]:
+        hri = self._paused.get(name)
+        if hri is None:
+            return None
+        row = self._alloc_row(name)
+        if row is None:
+            return None
+        del self._paused[name]
+        # reset the row to a clean slate, then restore the scalar columns
+        mask = hri["member"].reshape(1, -1)
+        self.state = st.create_groups(
+            self.state, np.array([row], np.int32), mask,
+            np.array([hri["epoch"]], np.int32),
+        )
+        self.state = st.hot_restore(self.state, row, hri)
+        if hri.get("stopped"):
+            self._stopped_rows.add(row)
+        self._last_active[row] = self.tick_num
+        self.stats["unpaused"] += 1
+        if self.wal is not None:
+            self.wal.log_unpause(name)
+        return row
+
+    def paused_count(self) -> int:
+        return len(self._paused)
 
     # ---------------------------------------------------------------- propose
     @_locked
@@ -172,7 +297,7 @@ class PaxosManager:
 
         Returns the request id, or None if the group is unknown.
         """
-        row = self.rows.row(name)
+        row = self._resident_row(name)
         if row is None:
             return None
         if row in self._stopped_rows:
@@ -191,11 +316,25 @@ class PaxosManager:
             entry = int(members[rid % len(members)]) if len(members) else 0
         rec = RequestRecord(rid, name, row, payload, stop, callback, entry)
         self.outstanding[rid] = rec
+        self._row_outstanding[row] += 1
         self._queues[row].append(rid)
+        self._last_active[row] = self.tick_num
         return rid
 
     def propose_stop(self, name: str, payload: bytes = b"", callback=None):
         return self.propose(name, payload, callback, stop=True)
+
+    def _purge_row_outstanding(self, row: int) -> None:
+        """Drop placed-but-unfinished records of a removed group.  Without
+        this the row's outstanding counter stays >0 forever (free_groups
+        clears the member mask, so the sweep can never cover them) and the
+        recycled row becomes permanently unpausable."""
+        gone = [rid for rid, rec in self.outstanding.items() if rec.row == row]
+        for rid in gone:
+            rec = self.outstanding.pop(rid)
+            if rec.callback is not None and not rec.responded:
+                self._held_callbacks.append((rec.callback, rid, None))
+        self._row_outstanding.pop(row, None)
 
     def _fail_queued(self, row: int) -> None:
         """Fail queued-but-never-committed requests for a stopped/removed
@@ -206,8 +345,10 @@ class PaxosManager:
             return
         for rid in q:
             rec = self.outstanding.pop(rid, None)
-            if rec is not None and rec.callback is not None and not rec.responded:
-                self._held_callbacks.append((rec.callback, rid, None))
+            if rec is not None:
+                self._row_outstanding[rec.row] -= 1
+                if rec.callback is not None and not rec.responded:
+                    self._held_callbacks.append((rec.callback, rid, None))
             self.stats["failed_requests"] += 1
 
     # ------------------------------------------------------------------- tick
@@ -260,6 +401,12 @@ class PaxosManager:
         self._flush_callbacks()
         if self.tick_num % 64 == 0:
             self._sweep_outstanding()
+        if (
+            self.cfg.paxos.deactivation_ticks > 0
+            and self.tick_num % 256 == 0
+            and len(self.rows) > 0
+        ):
+            self.pause_idle()
         return out
 
     def _flush_callbacks(self) -> None:
@@ -289,6 +436,7 @@ class PaxosManager:
             name = self.rows.name(int(row))
             if name is None:
                 continue
+            self._last_active[row] = self.tick_num
             for r in range(self.R):
                 n = int(ec[r, row])
                 for j in range(n):
@@ -328,6 +476,7 @@ class PaxosManager:
         members = int(self.state.n_members[row])
         if len(rec.executed_by) >= members and rec.responded:
             del self.outstanding[rid]
+            self._row_outstanding[row] -= 1
 
     def _sweep_outstanding(self) -> None:
         """Drop responded records whose slot every live member has passed
@@ -346,6 +495,7 @@ class PaxosManager:
             if live and all(exec_slot[m, rec.row] > rec.slot for m in live):
                 dead.append(rid)
         for rid in dead:
+            self._row_outstanding[self.outstanding[rid].row] -= 1
             del self.outstanding[rid]
             self.stats["swept"] += 1
 

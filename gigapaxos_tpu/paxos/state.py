@@ -201,3 +201,71 @@ def free_groups(state: PaxosState, rows: np.ndarray) -> PaxosState:
         member=state.member.at[:, rows].set(False),
         n_members=state.n_members.at[rows].set(0),
     )
+
+
+# --------------------------------------------------------------- pause/spill
+#
+# The reference proves a paused group's resident state is ~9 scalars
+# (HotRestoreInfo, paxosutil/HotRestoreInfo.java:31-69: accept slot/ballot/
+# gcSlot + coordinator ballot/nextProposalSlot + members); the dense design's
+# analog of "pause" (PaxosManager.java:2284-2365) is spilling those scalar
+# columns to host RAM and freeing the device row for a hot group.  Ring
+# contents are deliberately NOT spilled: a group is only pausable when every
+# member is caught up (exec == next slot), at which point the windows hold
+# nothing undelivered.
+
+def extract_hri(state: PaxosState, row: int) -> dict:
+    """Host-side HotRestoreInfo of one caught-up group row."""
+    r = int(row)
+    return {
+        "exec_slot": np.array(state.exec_slot[:, r]),
+        "bal_num": np.array(state.bal_num[:, r]),
+        "bal_coord": np.array(state.bal_coord[:, r]),
+        "status": np.array(state.status[:, r]),
+        "coord_active": np.array(state.coord_active[:, r]),
+        "coord_bnum": np.array(state.coord_bnum[:, r]),
+        "next_slot": np.array(state.next_slot[:, r]),
+        "member": np.array(state.member[:, r]),
+        "epoch": int(state.epoch[r]),
+    }
+
+
+def hot_restore(state: PaxosState, row: int, hri: dict) -> PaxosState:
+    """Re-materialize a spilled group into a (fresh) device row
+    (``hotRestore``, PaxosAcceptor.java:128).  The row must have been reset
+    by :func:`create_groups`/:func:`free_groups` semantics first — this only
+    writes the scalar columns; windows start empty, which is correct because
+    pause required the group to be quiescent."""
+    r = int(row)
+    return state._replace(
+        exec_slot=state.exec_slot.at[:, r].set(jnp.asarray(hri["exec_slot"], I32)),
+        bal_num=state.bal_num.at[:, r].set(jnp.asarray(hri["bal_num"], I32)),
+        bal_coord=state.bal_coord.at[:, r].set(jnp.asarray(hri["bal_coord"], I32)),
+        status=state.status.at[:, r].set(jnp.asarray(hri["status"], I32)),
+        acc_bnum=state.acc_bnum.at[:, :, r].set(INITIAL_BALLOT_NUM),
+        acc_bcoord=state.acc_bcoord.at[:, :, r].set(INITIAL_BALLOT_COORD),
+        acc_req=state.acc_req.at[:, :, r].set(NO_REQUEST),
+        acc_slot=state.acc_slot.at[:, :, r].set(-1),
+        acc_stop=state.acc_stop.at[:, :, r].set(False),
+        dec_req=state.dec_req.at[:, :, r].set(NO_REQUEST),
+        dec_slot=state.dec_slot.at[:, :, r].set(-1),
+        dec_valid=state.dec_valid.at[:, :, r].set(False),
+        dec_stop=state.dec_stop.at[:, :, r].set(False),
+        coord_active=state.coord_active.at[:, r].set(
+            jnp.asarray(hri["coord_active"], BOOL)
+        ),
+        coord_preparing=state.coord_preparing.at[:, r].set(False),
+        coord_bnum=state.coord_bnum.at[:, r].set(
+            jnp.asarray(hri["coord_bnum"], I32)
+        ),
+        next_slot=state.next_slot.at[:, r].set(jnp.asarray(hri["next_slot"], I32)),
+        prop_req=state.prop_req.at[:, :, r].set(NO_REQUEST),
+        prop_slot=state.prop_slot.at[:, :, r].set(-1),
+        prop_valid=state.prop_valid.at[:, :, r].set(False),
+        prop_stop=state.prop_stop.at[:, :, r].set(False),
+        member=state.member.at[:, r].set(jnp.asarray(hri["member"], BOOL)),
+        n_members=state.n_members.at[r].set(
+            jnp.int32(int(np.sum(hri["member"])))
+        ),
+        epoch=state.epoch.at[r].set(jnp.int32(hri["epoch"])),
+    )

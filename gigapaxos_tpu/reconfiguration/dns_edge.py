@@ -15,7 +15,8 @@ from __future__ import annotations
 import socket
 import struct
 import threading
-from typing import Callable, List, Optional, Tuple  # noqa: F401
+import time
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..client import ClientError, ReconfigurableAppClient
 
@@ -45,6 +46,7 @@ class DnsReconfigurator:
         self.zone = zone.strip(".")
         self.ttl = ttl
         self.policy = policy
+        self._host_cache: Dict[str, Tuple[float, Optional[str]]] = {}
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self.sock.bind(bind)
         self.sock.settimeout(0.25)
@@ -107,14 +109,34 @@ class DnsReconfigurator:
             if self.client.nodemap(a) is not None
         }
         ips = []
+        failed = 0
         for ip in self.policy(name, actives, addrs):
             # topology may name hosts ('localhost', 'node1.internal');
-            # A records need dotted quads
-            try:
-                ips.append(socket.gethostbyname(ip))
-            except OSError:
-                continue
+            # A records need dotted quads.  Lookups are cached so a
+            # resolver hiccup can't block every query for its timeout.
+            got = self._host_ip(ip)
+            if got is None:
+                failed += 1
+            else:
+                ips.append(got)
+        if failed and not ips:
+            # every host lookup failed transiently: SERVFAIL, never a
+            # negative-cacheable empty NOERROR for a healthy name
+            return "servfail", None
         return "ok", ips
+
+    def _host_ip(self, host: str) -> Optional[str]:
+        now = time.monotonic()
+        hit = self._host_cache.get(host)
+        if hit is not None and hit[0] > now:
+            return hit[1]
+        try:
+            ip = socket.gethostbyname(host)
+            self._host_cache[host] = (now + 60.0, ip)
+            return ip
+        except OSError:
+            self._host_cache[host] = (now + 5.0, None)  # brief negative cache
+            return None
 
     def _answer(self, q: bytes) -> Optional[bytes]:
         if len(q) < 12:

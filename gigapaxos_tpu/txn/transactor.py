@@ -86,22 +86,30 @@ class TxApp(Replicable):
         return b"TX_BADOP"
 
     def checkpoint(self, name: str) -> bytes:
+        # ALWAYS envelope — an unwrapped inner blob that happened to begin
+        # with TX_MAGIC would be misparsed as a lock header on restore
         inner = self.app.checkpoint(name)
         holder = self.locks.get(name)
-        if holder is None:
-            return inner  # fast path: plain app state
         return TX_MAGIC + json.dumps({"holder": holder}).encode() + b"\x00" + inner
 
     def restore(self, name: str, state: bytes) -> None:
         if state.startswith(TX_MAGIC):
             body = state[len(TX_MAGIC):]
             sep = body.find(b"\x00")
-            meta = json.loads(body[:sep].decode())
-            self.locks[name] = meta["holder"]
-            self.app.restore(name, body[sep + 1:])
-        else:
-            self.locks.pop(name, None)
-            self.app.restore(name, state)
+            try:
+                meta = json.loads(body[:sep].decode())
+            except (ValueError, UnicodeDecodeError):
+                meta = None  # raw client state that collides with the magic
+            if meta is not None:
+                if meta.get("holder") is None:
+                    self.locks.pop(name, None)
+                else:
+                    self.locks[name] = meta["holder"]
+                self.app.restore(name, body[sep + 1:])
+                return
+        # plain state (client-provided initial state / legacy checkpoint)
+        self.locks.pop(name, None)
+        self.app.restore(name, state)
 
 
 class TxResult:

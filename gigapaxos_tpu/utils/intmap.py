@@ -27,6 +27,9 @@ class RowAllocator:
     def __contains__(self, name: str) -> bool:
         return name in self._name_to_row
 
+    def full(self) -> bool:
+        return not self._free
+
     def alloc(self, name: str) -> int:
         if name in self._name_to_row:
             raise KeyError(f"{name!r} already allocated")

@@ -39,6 +39,8 @@ from ..paxos.state import PaxosState
 OP_CREATE = 1
 OP_REMOVE = 2
 OP_TICK = 3
+OP_PAUSE = 4
+OP_UNPAUSE = 5
 
 
 def _new_journal(path: str, native_ok: bool):
@@ -96,6 +98,15 @@ class PaxosLogger:
         self.journal.append(pickle.dumps((OP_REMOVE, name)))
         self.journal.sync()
 
+    def log_pause(self, names) -> None:
+        """Pause/unpause change row allocation, and journaled tick records
+        address groups BY ROW — replay must re-apply the same spills in the
+        same order or placements would land on the wrong groups."""
+        self.journal.append(pickle.dumps((OP_PAUSE, list(names))))
+
+    def log_unpause(self, name: str) -> None:
+        self.journal.append(pickle.dumps((OP_UNPAUSE, name)))
+
     def log_inbox(self, tick_num: int, inbox) -> None:
         """Called by the manager after `_build_inbox`, before running the
         tick: record exactly what was placed, with payloads for replay."""
@@ -149,8 +160,16 @@ class PaxosLogger:
                 for r in m.outstanding.values()
             ],
             "queues": {row: list(q) for row, q in m._queues.items() if q},
+            # paused groups live only in the spill store + host app state:
+            # a snapshot that dropped them would lose them forever once the
+            # journal holding their OP_CREATE is GC'd
+            "paused": dict(getattr(m, "_paused", {})),
             "apps": [
-                {name: m.apps[i].checkpoint(name) for name in m.rows.names()}
+                {
+                    name: m.apps[i].checkpoint(name)
+                    for name in list(m.rows.names())
+                    + list(getattr(m, "_paused", {}))
+                }
                 for i in range(m.R)
             ],
         }
@@ -223,6 +242,10 @@ def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
                     m.create_paxos_instance(name, members, epoch)
             elif op == OP_REMOVE:
                 m.remove_paxos_instance(rec[1])
+            elif op == OP_PAUSE:
+                m._do_pause([n for n in rec[1] if n in m.rows])
+            elif op == OP_UNPAUSE:
+                m._unpause(rec[1])
             elif op == OP_TICK:
                 _, tick_num, placed, alive_b = rec
                 if tick_num < m.tick_num:
@@ -295,6 +318,13 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True):
             m.outstanding[rid] = rec
         for row, rids in meta["queues"].items():
             m._queues[int(row)] = collections.deque(rids)
+        m._paused = dict(meta.get("paused", {}))
+        # derived bookkeeping the snapshot does not carry directly
+        m._row_outstanding = collections.Counter(
+            rec.row for rec in m.outstanding.values()
+        )
+        for row in m.rows._row_to_name:
+            m._last_active[row] = m.tick_num
         for i in range(m.R):
             for name, blob in meta["apps"][i].items():
                 m.apps[i].restore(name, blob)

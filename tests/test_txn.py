@@ -117,7 +117,10 @@ def test_txapp_checkpoint_carries_lock(plane):
     fresh.restore("n", blob)
     assert fresh.locks["n"] == "t1"
     assert fresh.app.db["n"]["k"] == "v"
-    # plain checkpoint (no lock) round-trips without the TX envelope
+    # unlocked checkpoints are enveloped too (an inner blob beginning with
+    # the magic must not be misparsed), and restore clears a stale lock
     app.execute("n", tx_payload("unlock", "t1"), 3)
     blob2 = app.checkpoint("n")
-    assert not blob2.startswith(b"\x01TX\x01")
+    assert blob2.startswith(b"\x01TX\x01")
+    fresh.restore("n", blob2)
+    assert "n" not in fresh.locks and fresh.app.db["n"]["k"] == "v"

@@ -74,7 +74,8 @@ class ReconfigurableAppClient:
         self._sent_at: Dict[int, Tuple[str, float]] = {}
         for t in (pkt.CREATE_RESPONSE, pkt.DELETE_RESPONSE,
                   pkt.ACTIVES_RESPONSE, pkt.RECONFIGURE_RESPONSE,
-                  pkt.APP_RESPONSE, pkt.ECHO_REPLY):
+                  pkt.APP_RESPONSE, pkt.ECHO_REPLY,
+                  pkt.NODE_CONFIG_RESPONSE):
             self.m.register(t, self._on_response)
 
     def close(self) -> None:
@@ -155,6 +156,23 @@ class ReconfigurableAppClient:
         resp = self._rpc_rc(pkt.client_reconfigure(name, new_actives, 0), timeout)
         with self._lock:
             self._actives.pop(name, None)
+        return resp
+
+    # ------------------------------------------------------ node elasticity
+    def add_active(self, node: str, host: str, port: int,
+                   timeout: float = 15.0) -> dict:
+        """Admin: add an active node to the deployment's pool
+        (ReconfigureActiveNodeConfig analog)."""
+        resp = self._rpc_rc({"type": pkt.ADD_ACTIVE, "node": node,
+                             "addr": [host, port]}, timeout)
+        if resp.get("ok"):
+            self.nodemap.add(node, host, port)
+        return resp
+
+    def remove_active(self, node: str, timeout: float = 15.0) -> dict:
+        resp = self._rpc_rc({"type": pkt.REMOVE_ACTIVE, "node": node}, timeout)
+        with self._lock:
+            self._actives.clear()  # placements may be migrating
         return resp
 
     def request_actives(self, name: str, timeout: float = 10.0,

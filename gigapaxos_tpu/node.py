@@ -63,6 +63,7 @@ class InProcessCluster:
         rc_wal=None,
         start_fd: bool = False,
         coordinator: str = "paxos",
+        spare_replica_slots: int = 0,
     ):
         self.cfg = cfg
         active_ids = cfg.nodes.active_ids()
@@ -73,14 +74,19 @@ class InProcessCluster:
         # ---------------- data plane (shared dense device state, Mode A)
         # the coordination protocol is pluggable exactly like the reference's
         # REPLICA_COORDINATOR_CLASS (ReconfigurableNode.java:203-218)
-        apps = [app_factory() for _ in active_ids]
+        # spare slots = provisioned-but-unbound replica capacity for runtime
+        # active-node adds (elasticity binds node ids to spare slots)
+        self._demand_profile_factory = demand_profile_factory
+        self._rc_group_size = rc_group_size
+        n_slots = len(active_ids) + spare_replica_slots
+        apps = [app_factory() for _ in range(n_slots)]
         if coordinator == "chain":
             from .chain import ChainManager, ChainReplicaCoordinator
 
-            self.manager = ChainManager(cfg, len(active_ids), apps, wal=wal)
+            self.manager = ChainManager(cfg, n_slots, apps, wal=wal)
             self.coordinator = ChainReplicaCoordinator(self.manager, active_ids)
         elif coordinator == "paxos":
-            self.manager = PaxosManager(cfg, len(active_ids), apps, wal=wal)
+            self.manager = PaxosManager(cfg, n_slots, apps, wal=wal)
             self.coordinator = PaxosReplicaCoordinator(self.manager, active_ids)
         else:
             raise ValueError(f"unknown coordinator {coordinator!r}")
@@ -139,6 +145,41 @@ class InProcessCluster:
 
     def _fd_change(self, node: str, up: bool) -> None:
         self._liveness[node] = up
+
+    # ------------------------------------------------------------- elasticity
+    def add_active_endpoint(self, node_id: str,
+                            bind=("127.0.0.1", 0)) -> ActiveReplica:
+        """Local wiring for a runtime active-node add: bind a spare replica
+        slot and start the node's control-plane endpoint.  Pair with an
+        admin ``add_active`` request to a reconfigurator so the RC pool
+        learns the node (the committed NC change carries the address)."""
+        slot = self.coordinator.bind_node(node_id)
+        if slot is None:
+            raise RuntimeError("no spare replica slots provisioned")
+        self.manager.set_alive(slot, True)  # slot may be recycled from a remove
+        m = Messenger(node_id, bind, self.nodemap)
+        self.nodemap.add(node_id, bind[0], m.port)
+        self.cfg.nodes.actives[node_id] = (bind[0], m.port)
+        ar = ActiveReplica(
+            node_id, m, self.coordinator, self.cfg.nodes.reconfigurator_ids(),
+            demand_profile_factory=self._demand_profile_factory,
+            rc_group_size=self._rc_group_size,
+        )
+        self.actives[node_id] = ar
+        self._liveness[node_id] = True
+        return ar
+
+    def remove_active_endpoint(self, node_id: str) -> None:
+        """Tear down a removed node's endpoint (after the admin
+        ``remove_active`` request migrated its names away)."""
+        ar = self.actives.pop(node_id, None)
+        if ar is not None:
+            ar.close()
+        slot = self.coordinator.unbind_node(node_id)
+        if slot is not None:
+            self.manager.set_alive(slot, False)  # dead until rebound
+        self.cfg.nodes.actives.pop(node_id, None)
+        self._liveness[node_id] = False
 
     # ----------------------------------------------------------------- admin
     def kick(self) -> None:

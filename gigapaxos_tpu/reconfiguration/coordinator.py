@@ -92,6 +92,33 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
     def slot_of(self, node_id: str) -> Optional[int]:
         return self._slot.get(node_id)
 
+    def bind_node(self, node_id: str) -> Optional[int]:
+        """Bind a new node id to a spare replica slot (runtime active-node
+        add).  Replica slots are provisioned mesh-axis capacity: the manager
+        was built with R slots, and elasticity binds/unbinds node ids to
+        them — the TPU framing of ReconfigureActiveNodeConfig."""
+        if node_id in self._slot:
+            return self._slot[node_id]
+        used = set(self._slot.values())
+        for s in range(self.manager.R):
+            if s not in used:
+                self._slot[node_id] = s
+                while len(self.node_ids) <= s:
+                    self.node_ids.append(None)
+                self.node_ids[s] = node_id
+                return s
+        return None  # no spare slots provisioned
+
+    def unbind_node(self, node_id: str) -> Optional[int]:
+        """Release a removed node's replica slot so it can be rebound.
+        Control-plane only: any group rows still naming the slot are
+        expected to have been migrated away first (the slot stays dead
+        until rebound, so stragglers merely see one dead member)."""
+        s = self._slot.pop(node_id, None)
+        if s is not None and s < len(self.node_ids):
+            self.node_ids[s] = None
+        return s
+
     def current_epoch(self, name: str) -> Optional[int]:
         return self._epoch.get(name)
 

@@ -34,6 +34,12 @@ DELETE_RESPONSE = "delete_response"
 ACTIVES_RESPONSE = "actives_response"
 RECONFIGURE_RESPONSE = "reconfigure_response"
 
+# admin <-> reconfigurator (node-config elasticity,
+# ReconfigureActiveNodeConfig / Reconfigurator.handleReconfigureRCNodeConfig:1044)
+ADD_ACTIVE = "add_active"
+REMOVE_ACTIVE = "remove_active"
+NODE_CONFIG_RESPONSE = "node_config_response"
+
 # client <-> active replica
 APP_REQUEST = "app_request"                        # AppRequest / ReplicableClientRequest
 APP_RESPONSE = "app_response"

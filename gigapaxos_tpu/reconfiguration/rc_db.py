@@ -28,6 +28,7 @@ propose (the basis of primary-failover, WaitPrimaryExecution.java:60).
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from typing import Callable, Dict, List, Optional
 
@@ -80,13 +81,46 @@ class ReconfiguratorDB(Replicable):
             result = self._apply(cmd)
         if self.listener is not None:
             rec = self.get(cmd.get("name", ""))
-            self.listener(cmd, rec.to_dict() if rec is not None else None)
+            try:
+                self.listener(cmd, rec.to_dict() if rec is not None else None)
+            except Exception:
+                # a listener bug must not poison the deterministic apply
+                # (execute runs on the data-plane tick thread) — but it must
+                # be visible: it can silently disable failover watchdogs
+                logging.getLogger("gigapaxos_tpu.rc_db").exception(
+                    "DB listener failed on %s", cmd.get("op")
+                )
         return json.dumps(result).encode()
 
     def _apply(self, cmd: dict) -> dict:
         op = cmd["op"]
         name = cmd["name"]
         rec = self.records.get(name)
+        if op in ("add_active", "remove_active"):
+            if name != NC_RECORD:
+                # node-config ops are only valid on the NC record; applied to
+                # a service record they would desync its epoch from the live
+                # paxos group and brick the name
+                return {"ok": False, "error": "nc_only"}
+            # node-config change on the NC record: rec.actives is the active
+            # POOL (ReconfigureActiveNodeConfig analog); per-name membership
+            # changes flow as ordinary reconfigurations afterwards
+            if rec is None:
+                # first NC change: seed the pool with the boot topology
+                # (carried in the committed command so every replica derives
+                # the identical record)
+                rec = self.records[name] = ReconfigurationRecord(
+                    name=name, actives=sorted(cmd.get("seed_pool", []))
+                )
+            node = cmd["node"]
+            pool = set(rec.actives)
+            if op == "add_active":
+                pool.add(node)
+            else:
+                pool.discard(node)
+            rec.actives = sorted(pool)
+            rec.epoch += 1  # NC epoch counts config versions
+            return {"ok": True, "pool": rec.actives, "epoch": rec.epoch}
         if op == "create":
             if rec is not None:
                 return {"ok": False, "error": "exists", "epoch": rec.epoch}
@@ -180,7 +214,12 @@ class RepliconfigurableReconfiguratorDB:
 
     # ---------------------------------------------------------------- groups
     def rc_group_of(self, name: str) -> List[str]:
-        """The k reconfigurators owning ``name`` (its RC group)."""
+        """The k reconfigurators owning ``name`` (its RC group).  The
+        node-config record is replicated on ALL reconfigurators (the
+        reference's RC_NODES/AR_NODES groups span every RC,
+        ReconfigurableNode.java:180-188)."""
+        if name == NC_RECORD:
+            return list(self.rc_ids)
         return self.ring.replicated_servers(name, self.k)
 
     def primary_of(self, name: str) -> str:

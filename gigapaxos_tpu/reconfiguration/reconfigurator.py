@@ -175,6 +175,34 @@ class WaitPrimaryExecution(ProtocolTask):
         return [], True  # explicit completion event (unused today)
 
 
+class NodeDrainTask(ProtocolTask):
+    """Retrying drain of a removed active: sweeps until no record this RC
+    can see still lists the node (names that were mid-reconfiguration at
+    NC-commit time get migrated on a later sweep)."""
+
+    period_s = 1.5
+    max_restarts = 60
+
+    def __init__(self, rc: "Reconfigurator", node: str):
+        self.rc, self.node = rc, node
+
+    @property
+    def key(self) -> str:
+        return f"NodeDrain:{self.node}"
+
+    def start(self):
+        self.rc._drain_node_once(self.node)
+        return []
+
+    def restart(self):
+        if self.rc._drain_node_once(self.node) == 0:
+            self.rc.executor.cancel(self.key)
+        return []
+
+    def handle(self, event):
+        return [], True
+
+
 class Reconfigurator:
     def __init__(
         self,
@@ -210,6 +238,8 @@ class Reconfigurator:
             (pkt.ACK_STOP_EPOCH, self._route_ack("WaitAckStopEpoch")),
             (pkt.ACK_START_EPOCH, self._route_ack("WaitAckStartEpoch")),
             (pkt.ACK_DROP_EPOCH, self._route_ack("WaitAckDropEpoch")),
+            (pkt.ADD_ACTIVE, self._on_node_config),
+            (pkt.REMOVE_ACTIVE, self._on_node_config),
         ]:
             self.m.register(ptype, h)
 
@@ -490,12 +520,95 @@ class Reconfigurator:
                 self, name, rec.epoch, list(rec.actives), stopped
             ))
 
+    # ------------------------------------------------------- node elasticity
+    def _on_node_config(self, sender: str, p: dict) -> None:
+        """handleReconfigureRCNodeConfig analog (Reconfigurator.java:1044):
+        add/remove an active node at runtime.  The change commits through
+        the all-RC node-config record, so every reconfigurator updates its
+        pool/ring deterministically from the commit stream; names placed on
+        a removed node are migrated away as ordinary reconfigurations."""
+        pkt.register_client(self.m.nodemap, p)
+        node, rid = p.get("node"), p.get("rid")
+
+        def reject(error: str) -> None:
+            self.m.send(sender, {
+                "type": pkt.NODE_CONFIG_RESPONSE, "rid": rid, "ok": False,
+                "error": error,
+            })
+
+        if not node:
+            reject("need node")
+            return
+        removing = p["type"] == pkt.REMOVE_ACTIVE
+        if removing:
+            if node not in self.actives_pool:
+                reject("unknown_node")
+                return
+            if len(self.actives_pool) - 1 < self.k:
+                # shrinking below replicas_per_name would silently
+                # under-replicate every migrated name
+                reject("pool_too_small")
+                return
+        cmd = {"op": "remove_active" if removing else "add_active",
+               "name": NC_RECORD, "node": node, "addr": p.get("addr"),
+               "seed_pool": sorted(self.actives_pool)}
+
+        def committed(result: dict) -> None:
+            self.m.send(sender, {
+                "type": pkt.NODE_CONFIG_RESPONSE, "rid": rid,
+                "ok": bool(result.get("ok")), "node": node,
+                "pool": result.get("pool"),
+            })
+
+        self.rdb.commit(NC_RECORD, cmd, committed, proposer=self.node_id)
+
+    def _apply_node_config(self, cmd: dict, record: Optional[dict]) -> None:
+        node = cmd["node"]
+        pool = sorted(record["actives"]) if record else self.actives_pool
+        with self._lock:
+            self.actives_pool = pool
+            self.actives_ring = ConsistentHashRing(pool)
+        if cmd["op"] == "add_active":
+            addr = cmd.get("addr")
+            if addr and self.m.nodemap(node) is None:
+                self.m.nodemap.add(node, addr[0], int(addr[1]))
+            return
+        # removal: drain the node with a retrying task, not a one-shot pass —
+        # names mid-reconfiguration (or whose primary is down) at commit time
+        # must still be migrated once they quiesce
+        self.executor.schedule(NodeDrainTask(self, node))
+
+    def _drain_node_once(self, node: str) -> int:
+        """One drain sweep: migrate off ``node`` every name this RC should
+        drive.  Returns how many names still reference the node."""
+        remaining = 0
+        pool = self.actives_pool
+        for name in self.db.names():
+            rec = self.db.get(name)
+            if rec is None or node not in rec.actives:
+                continue
+            remaining += 1
+            primary = self.rdb.primary_of(name)
+            drive = primary == self.node_id or not self.is_node_up(primary)
+            if not drive or not rec.can_reconfigure():
+                continue
+            keep = [a for a in rec.actives if a != node]
+            spare = [a for a in pool if a not in keep]
+            new = sorted(keep + spare[: max(0, len(rec.actives) - len(keep))])
+            if new and sorted(new) != sorted(rec.actives):
+                self._reconfigure(name, new)
+        return remaining
+
     # --------------------------------------------------------- commit events
     def _on_db_commit(self, cmd: dict, record: Optional[dict]) -> None:
         """Listener on this node's DB replica: non-primary RC-group members
         arm the failover watchdog when they see an intent commit."""
         name = cmd.get("name")
-        if name is None or name == NC_RECORD:
+        if name is None:
+            return
+        if name == NC_RECORD:
+            if cmd.get("op") in ("add_active", "remove_active"):
+                self._apply_node_config(cmd, record)
             return
         op = cmd.get("op")
         if op == "delete_complete":

@@ -1,0 +1,3 @@
+from .capacity import CapacityProbe, ProbeResult, make_loopback_cluster
+
+__all__ = ["CapacityProbe", "ProbeResult", "make_loopback_cluster"]

@@ -1,0 +1,201 @@
+"""Capacity-probe harness: the TESTPaxos analog.
+
+Reproduces the reference's benchmark methodology end-to-end over real
+sockets (``testing/TESTPaxosMain.java:43`` spawns in-JVM nodes,
+``TESTPaxosClient.java:59`` drives load, probe parameters
+``TESTPaxosConfig.java:190-229``): start at an initial load, multiply by
+``PROBE_LOAD_INCREASE_FACTOR`` (1.1) each run, and stop when the response
+rate drops below ``0.9 x load`` or average latency exceeds 1 s; the last
+passing load is the capacity.
+
+The in-process cluster mirrors ``tests/loopback_1_group`` /
+``loopback_10_groups`` (3 actives on loopback, NoopApp workload); this
+module is also the host-path complement of ``bench.py``, which measures the
+raw device engine without the socket edge.
+
+CLI: ``python -m gigapaxos_tpu.testing.capacity [--groups N] [--load L]``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..client import ReconfigurableAppClient
+from ..config import GigapaxosTpuConfig
+from ..models.replicable import NoopApp
+from ..node import InProcessCluster
+
+#: probe parameters (TESTPaxosConfig.java:190-229)
+PROBE_LOAD_INCREASE_FACTOR = 1.1
+PROBE_RESPONSE_THRESHOLD = 0.9
+PROBE_MAX_LATENCY_S = 1.0
+PROBE_MAX_RUNS = 50
+
+
+@dataclass
+class ProbeResult:
+    load: float  # offered req/s
+    sent: int
+    responded: int
+    errors: int
+    duration_s: float
+    latencies_s: List[float] = field(default_factory=list)
+
+    @property
+    def response_rate(self) -> float:
+        return self.responded / self.duration_s if self.duration_s else 0.0
+
+    @property
+    def avg_latency_s(self) -> float:
+        return (
+            sum(self.latencies_s) / len(self.latencies_s)
+            if self.latencies_s else 0.0
+        )
+
+    def p50_latency_s(self) -> float:
+        if not self.latencies_s:
+            return 0.0
+        xs = sorted(self.latencies_s)
+        return xs[len(xs) // 2]
+
+    def passed(self, load: float) -> bool:
+        return (
+            self.response_rate >= PROBE_RESPONSE_THRESHOLD * load
+            and self.avg_latency_s <= PROBE_MAX_LATENCY_S
+        )
+
+
+def make_loopback_cluster(
+    n_groups: int = 1,
+    n_actives: int = 3,
+    n_rc: int = 1,
+    app_factory=NoopApp,
+    max_groups: Optional[int] = None,
+):
+    """The ``tests/loopback_*`` fixture: one process, real sockets,
+    ``n_groups`` pre-created names g0..g{n-1} on 3 replicas."""
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = max_groups or max(64, n_groups)
+    for i in range(n_actives):
+        cfg.nodes.actives[f"AR{i}"] = ("127.0.0.1", 0)
+    for i in range(n_rc):
+        cfg.nodes.reconfigurators[f"RC{i}"] = ("127.0.0.1", 0)
+    cluster = InProcessCluster(cfg, app_factory)
+    client = ReconfigurableAppClient(cfg.nodes)
+    for g in range(n_groups):
+        resp = client.create(f"g{g}")
+        if not resp.get("ok"):
+            raise RuntimeError(f"create g{g} failed: {resp}")
+    return cluster, client
+
+
+class CapacityProbe:
+    """Drives open-loop load through the async client and walks the probe
+    ladder (TESTPaxosClient's runTestWorkload + capacity loop)."""
+
+    def __init__(self, client: ReconfigurableAppClient, names: List[str],
+                 payload: bytes = b"noop"):
+        self.client = client
+        self.names = names
+        self.payload = payload
+        # pre-resolve every name so measurement excludes actives lookups
+        for n in names:
+            self.client.request_actives(n)
+
+    def run_once(self, load: float, duration_s: float) -> ProbeResult:
+        res = ProbeResult(load=load, sent=0, responded=0, errors=0,
+                          duration_s=duration_s)
+        lock = threading.Lock()
+        t_end = time.monotonic() + duration_s
+        interval = 1.0 / load
+        i = 0
+        next_t = time.monotonic()
+        while time.monotonic() < t_end:
+            now = time.monotonic()
+            if now < next_t:
+                time.sleep(min(next_t - now, 0.002))
+                continue
+            next_t += interval
+            name = self.names[i % len(self.names)]
+            i += 1
+            t0 = time.monotonic()
+
+            def cb(p, t0=t0):
+                with lock:
+                    if p.get("ok"):
+                        res.responded += 1
+                        res.latencies_s.append(time.monotonic() - t0)
+                    else:
+                        res.errors += 1
+
+            try:
+                self.client.send_request(name, self.payload, cb)
+                res.sent += 1
+            except Exception:
+                res.errors += 1
+        # drain window: late responses still count against offered load
+        deadline = time.monotonic() + min(2.0, PROBE_MAX_LATENCY_S * 2)
+        while time.monotonic() < deadline:
+            with lock:
+                if res.responded + res.errors >= res.sent:
+                    break
+            time.sleep(0.01)
+        return res
+
+    def probe(self, init_load: float, duration_s: float = 2.0,
+              max_runs: int = PROBE_MAX_RUNS) -> List[ProbeResult]:
+        """The capacity ladder; returns all runs (last passing = capacity)."""
+        runs: List[ProbeResult] = []
+        load = init_load
+        for _ in range(max_runs):
+            r = self.run_once(load, duration_s)
+            runs.append(r)
+            if not r.passed(load):
+                break
+            load *= PROBE_LOAD_INCREASE_FACTOR
+        return runs
+
+    @staticmethod
+    def capacity(runs: List[ProbeResult]) -> float:
+        passing = [r.load for r in runs if r.passed(r.load)]
+        return max(passing) if passing else 0.0
+
+
+def main() -> None:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--groups", type=int, default=10)
+    ap.add_argument("--load", type=float, default=1000.0)
+    ap.add_argument("--duration", type=float, default=2.0)
+    ap.add_argument("--runs", type=int, default=10)
+    args = ap.parse_args()
+
+    cluster, client = make_loopback_cluster(n_groups=args.groups)
+    try:
+        probe = CapacityProbe(client, [f"g{i}" for i in range(args.groups)])
+        runs = probe.probe(args.load, args.duration, args.runs)
+        for r in runs:
+            print(json.dumps({
+                "load": round(r.load, 1),
+                "response_rate": round(r.response_rate, 1),
+                "avg_latency_ms": round(r.avg_latency_s * 1e3, 2),
+                "p50_latency_ms": round(r.p50_latency_s() * 1e3, 2),
+                "passed": r.passed(r.load),
+            }))
+        print(json.dumps({
+            "metric": f"loopback_capacity_req_per_s_{args.groups}_groups",
+            "value": round(CapacityProbe.capacity(runs), 1),
+            "unit": "req/s",
+        }))
+    finally:
+        client.close()
+        cluster.close()
+
+
+if __name__ == "__main__":
+    main()

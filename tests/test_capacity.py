@@ -1,0 +1,33 @@
+"""Capacity-probe harness smoke tests (TESTPaxos analog, modest load so CI
+stays fast; the full ladder runs via the CLI)."""
+
+from gigapaxos_tpu.testing import CapacityProbe, make_loopback_cluster
+
+
+def test_loopback_probe_one_group():
+    cluster, client = make_loopback_cluster(n_groups=1)
+    try:
+        probe = CapacityProbe(client, ["g0"])
+        r = probe.run_once(load=100.0, duration_s=1.5)
+        assert r.sent > 100
+        assert r.responded >= 0.9 * r.sent, (r.sent, r.responded, r.errors)
+        assert r.avg_latency_s < 1.0
+        assert r.passed(100.0)
+    finally:
+        client.close()
+        cluster.close()
+
+
+def test_probe_ladder_stops_on_failure():
+    cluster, client = make_loopback_cluster(n_groups=4)
+    try:
+        probe = CapacityProbe(client, [f"g{i}" for i in range(4)])
+        runs = probe.probe(init_load=50.0, duration_s=1.0, max_runs=3)
+        assert 1 <= len(runs) <= 3
+        assert CapacityProbe.capacity(runs) >= 0
+        # monotone ladder
+        loads = [r.load for r in runs]
+        assert loads == sorted(loads)
+    finally:
+        client.close()
+        cluster.close()

@@ -1,0 +1,66 @@
+"""Runtime node-config changes (ReconfigureActiveNodeConfig analog,
+Reconfigurator.handleReconfigureRCNodeConfig:1044): add an active on a spare
+replica slot, place new names on it, remove an active and watch its names
+migrate away with state intact."""
+
+import time
+
+import pytest
+
+from gigapaxos_tpu.client import ReconfigurableAppClient
+from gigapaxos_tpu.config import GigapaxosTpuConfig
+from gigapaxos_tpu.models.replicable import KVApp
+from gigapaxos_tpu.node import InProcessCluster
+
+
+@pytest.fixture(scope="module")
+def stack():
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 64
+    for i in range(4):
+        cfg.nodes.actives[f"AR{i}"] = ("127.0.0.1", 0)
+    for i in range(3):
+        cfg.nodes.reconfigurators[f"RC{i}"] = ("127.0.0.1", 0)
+    cl = InProcessCluster(cfg, KVApp, spare_replica_slots=2)
+    c = ReconfigurableAppClient(cfg.nodes)
+    yield cl, c
+    c.close()
+    cl.close()
+
+
+def test_add_active(stack):
+    cl, c = stack
+    ar = cl.add_active_endpoint("AR9")
+    host, port = cl.cfg.nodes.actives["AR9"]
+    r = c.add_active("AR9", host, port)
+    assert r["ok"] and "AR9" in r["pool"]
+    # every RC applied the committed pool change
+    for rc in cl.reconfigurators.values():
+        assert "AR9" in rc.actives_pool
+    # an explicit reconfigure can place a name on the new node
+    assert c.create("onnew")["ok"]
+    cur = c.request_actives("onnew")
+    target = sorted(["AR9"] + [a for a in cur if a != "AR9"][:2])
+    assert c.reconfigure("onnew", target)["ok"]
+    assert "AR9" in c.request_actives("onnew", force=True)
+    assert c.request("onnew", b"PUT k v") == b"OK"
+    assert c.request("onnew", b"GET k") == b"v"
+
+
+def test_remove_active_migrates_names(stack):
+    cl, c = stack
+    assert c.create("mv0")["ok"]
+    assert c.request("mv0", b"PUT home amherst") == b"OK"
+    victim = c.request_actives("mv0")[0]
+    r = c.remove_active(victim)
+    assert r["ok"] and victim not in r["pool"]
+    # primaries migrate affected names off the victim
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        actives = set(c.request_actives("mv0", force=True))
+        if victim not in actives:
+            break
+        time.sleep(0.25)
+    assert victim not in actives, f"mv0 still on {victim}: {actives}"
+    # data survived the forced migration
+    assert c.request("mv0", b"GET home") == b"amherst"

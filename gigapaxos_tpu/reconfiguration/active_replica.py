@@ -28,6 +28,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..net.bulk import BulkTransfer
 from ..net.messenger import Messenger
 from ..protocoltask.executor import ProtocolExecutor, ProtocolTask
 from . import packets as pkt
@@ -41,18 +42,26 @@ class WaitEpochFinalState(ProtocolTask):
     create the new epoch's group (WaitEpochFinalState.java:47)."""
 
     period_s = 0.5
-    max_restarts = 40
+    max_restarts = 240  # big states take a while; the RC retries anyway
+    #: after a bulk announcement, hold off re-requesting for this long —
+    #: every duplicate request triggers a full re-send of the state
+    announce_patience_s = 30.0
 
     def __init__(self, ar: "ActiveReplica", packet: dict):
         self.ar = ar
         self.p = packet
         self._i = 0
+        self._announced_at: Optional[float] = None
 
     @property
     def key(self) -> str:
         return f"WaitEpochFinalState:{self.p['name']}:{self.p['epoch']}"
 
     def start(self):
+        if self._announced_at is not None:
+            if time.monotonic() - self._announced_at < self.announce_patience_s:
+                return []  # chunks in flight; don't provoke duplicate sends
+            self._announced_at = None  # transfer presumably died: re-request
         name, prev = self.p["name"], self.p["prev_epoch"]
         targets = [a for a in self.p["prev_actives"] if a != self.ar.node_id]
         if not targets:
@@ -65,7 +74,13 @@ class WaitEpochFinalState(ProtocolTask):
     def handle(self, event: dict):
         if not event.get("found"):
             return [], False
-        state = pkt.b64d(event.get("state")) or b""
+        if "state_bytes" in event:  # assembled bulk transfer
+            state = event["state_bytes"]
+        elif event.get("bulk"):
+            self._announced_at = time.monotonic()
+            return [], False  # announced; the chunks are still in flight
+        else:
+            state = pkt.b64d(event.get("state")) or b""
         self.ar._create_started_epoch(self.p, state)
         return [], True
 
@@ -89,6 +104,10 @@ class ActiveReplica:
         self._profiles: Dict[str, AbstractDemandProfile] = {}
         self._plock = threading.Lock()
         self.executor = ProtocolExecutor(self.m.send, name=f"ar-{node_id}")
+        # chunked out-of-band channel for big epoch-final checkpoints
+        # (LargeCheckpointer analog, paxosutil/LargeCheckpointer.java:39)
+        self.bulk = BulkTransfer(self.m)
+        self.bulk.register_prefix("efs:", self._on_bulk_final_state)
         for ptype, h in [
             (pkt.APP_REQUEST, self._on_app_request),
             (pkt.STOP_EPOCH, self._on_stop_epoch),
@@ -210,9 +229,35 @@ class ActiveReplica:
             "type": pkt.ACK_DROP_EPOCH, "name": name, "epoch": epoch,
         })
 
+    #: checkpoints above this ride the chunked bulk channel instead of one
+    #: base64 JSON frame (LargeCheckpointer threshold idea)
+    inline_state_limit = 256 * 1024
+
     def _on_request_final_state(self, sender: str, p: dict) -> None:
-        state = self.coord.get_final_state(p["name"], p["epoch"])
-        self.m.send(p["requester"], pkt.epoch_final_state(p["name"], p["epoch"], state))
+        name, epoch = p["name"], p["epoch"]
+        state = self.coord.get_final_state(name, epoch)
+        if state is not None and len(state) > self.inline_state_limit:
+            self.m.send(p["requester"], {
+                "type": pkt.EPOCH_FINAL_STATE, "name": name, "epoch": epoch,
+                "found": True, "bulk": True,
+            })
+            # epoch leads in the key: names may themselves contain ':'.
+            # Worker thread: this handler runs on a transport reader thread,
+            # and a paced multi-GB send must not stall inbound processing.
+            threading.Thread(
+                target=self.bulk.send,
+                args=(p["requester"], f"efs:{epoch}:{name}", state),
+                name=f"efs-send-{name}", daemon=True,
+            ).start()
+            return
+        self.m.send(p["requester"], pkt.epoch_final_state(name, epoch, state))
+
+    def _on_bulk_final_state(self, sender: str, key: str, data: bytes) -> None:
+        epoch_s, name = key[len("efs:"):].split(":", 1)
+        self.executor.handle_event(
+            f"WaitEpochFinalState:{name}:{int(epoch_s) + 1}",
+            {"found": True, "state_bytes": data},
+        )
 
     def _on_epoch_final_state(self, sender: str, p: dict) -> None:
         self.executor.handle_event(

@@ -118,6 +118,13 @@ class ReconfiguratorDB(Replicable):
                 pool.add(node)
             else:
                 pool.discard(node)
+                # the shrink invariant must hold HERE, inside the totally
+                # ordered apply — the RC-side pre-check is only advisory
+                # (two concurrent removals can each pass it)
+                min_pool = int(cmd.get("min_pool", 0))
+                if len(pool) < min_pool:
+                    return {"ok": False, "error": "pool_too_small",
+                            "pool": rec.actives}
             rec.actives = sorted(pool)
             rec.epoch += 1  # NC epoch counts config versions
             return {"ok": True, "pool": rec.actives, "epoch": rec.epoch}

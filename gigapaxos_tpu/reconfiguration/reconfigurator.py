@@ -551,7 +551,7 @@ class Reconfigurator:
                 return
         cmd = {"op": "remove_active" if removing else "add_active",
                "name": NC_RECORD, "node": node, "addr": p.get("addr"),
-               "seed_pool": sorted(self.actives_pool)}
+               "seed_pool": sorted(self.actives_pool), "min_pool": self.k}
 
         def committed(result: dict) -> None:
             self.m.send(sender, {
@@ -570,7 +570,9 @@ class Reconfigurator:
             self.actives_ring = ConsistentHashRing(pool)
         if cmd["op"] == "add_active":
             addr = cmd.get("addr")
-            if addr and self.m.nodemap(node) is None:
+            if addr:
+                # overwrite unconditionally: a node removed and re-added at
+                # a new address must not keep its stale routing entry
                 self.m.nodemap.add(node, addr[0], int(addr[1]))
             return
         # removal: drain the node with a retrying task, not a one-shot pass —

@@ -39,14 +39,19 @@ PROBE_MAX_RUNS = 50
 class ProbeResult:
     load: float  # offered req/s
     sent: int
-    responded: int
+    responded: int  # total, including post-window stragglers
     errors: int
     duration_s: float
+    responded_in_window: int = 0
     latencies_s: List[float] = field(default_factory=list)
 
     @property
     def response_rate(self) -> float:
-        return self.responded / self.duration_s if self.duration_s else 0.0
+        """Sustained rate: only responses that arrived WITHIN the run
+        window count — a saturated system drains its backlog afterwards,
+        and counting that would overstate capacity by up to 2x."""
+        return (self.responded_in_window / self.duration_s
+                if self.duration_s else 0.0)
 
     @property
     def avg_latency_s(self) -> float:
@@ -124,10 +129,13 @@ class CapacityProbe:
             t0 = time.monotonic()
 
             def cb(p, t0=t0):
+                now = time.monotonic()
                 with lock:
                     if p.get("ok"):
                         res.responded += 1
-                        res.latencies_s.append(time.monotonic() - t0)
+                        if now <= t_end:
+                            res.responded_in_window += 1
+                        res.latencies_s.append(now - t0)
                     else:
                         res.errors += 1
 

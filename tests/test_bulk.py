@@ -1,0 +1,126 @@
+"""Chunked bulk transfer (LargeCheckpointer analog) tests."""
+
+import os
+import threading
+import time
+
+from gigapaxos_tpu.net.bulk import BulkTransfer
+from gigapaxos_tpu.net.messenger import Messenger, NodeMap
+
+
+def make_pair():
+    nm = NodeMap()
+    a = Messenger("A", ("127.0.0.1", 0), nm)
+    b = Messenger("B", ("127.0.0.1", 0), nm)
+    nm.add("A", "127.0.0.1", a.port)
+    nm.add("B", "127.0.0.1", b.port)
+    return a, b
+
+
+def test_roundtrip_large_blob():
+    a, b = make_pair()
+    try:
+        got = {}
+        ev = threading.Event()
+        BulkTransfer(b, on_complete=lambda s, k, d: (got.update({k: (s, d)}), ev.set()))
+        ta = BulkTransfer(a)
+        data = os.urandom(5 * 1024 * 1024 + 137)  # not chunk-aligned
+        n = ta.send("B", "efs:3:alice", data)
+        assert n == 6
+        assert ev.wait(30)
+        sender, rx = got["efs:3:alice"]
+        assert sender == "A" and rx == data
+    finally:
+        a.close()
+        b.close()
+
+
+def test_interleaved_keys_and_prefix_routing():
+    a, b = make_pair()
+    try:
+        got = {}
+        lock = threading.Lock()
+        done = threading.Event()
+        rx = BulkTransfer(b)
+
+        def h(sender, key, d):
+            with lock:
+                got[key] = d
+                if len(got) == 2:
+                    done.set()
+
+        rx.register_prefix("efs:", h)
+        ta = BulkTransfer(a, chunk_size=64 * 1024)
+        d1, d2 = os.urandom(300_000), os.urandom(200_000)
+        # interleave chunks of two transfers by sending alternately
+        ta.send("B", "efs:1:x", d1)
+        ta.send("B", "efs:2:y", d2)
+        assert done.wait(30)
+        assert got["efs:1:x"] == d1 and got["efs:2:y"] == d2
+        assert rx.pending() == 0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_big_final_state_over_bulk():
+    """An epoch-final checkpoint above the inline limit must travel the
+    bulk channel and still complete the WaitEpochFinalState task."""
+    from gigapaxos_tpu.config import GigapaxosTpuConfig
+    from gigapaxos_tpu.models.replicable import KVApp
+    from gigapaxos_tpu.client import ReconfigurableAppClient
+    from gigapaxos_tpu.node import InProcessCluster
+
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 64
+    for i in range(5):
+        cfg.nodes.actives[f"AR{i}"] = ("127.0.0.1", 0)
+    cfg.nodes.reconfigurators["RC0"] = ("127.0.0.1", 0)
+    cl = InProcessCluster(cfg, KVApp)
+    # force the remote-fetch path: tiny inline limit so ANY state is "big"
+    for ar in cl.actives.values():
+        ar.inline_state_limit = 64
+    c = ReconfigurableAppClient(cfg.nodes)
+    try:
+        assert c.create("fat")["ok"]
+        big = "x" * 500_000
+        assert c.request("fat", f"PUT blob {big}".encode()) == b"OK"
+        old = set(c.request_actives("fat"))
+        # stop epoch 0 so its final state becomes fetchable
+        stopped = threading.Event()
+        cl.coordinator.stop_replica_group("fat", 0, lambda ok: stopped.set())
+        assert stopped.wait(30)
+        # drive the AR-to-AR fetch protocol explicitly: AR_x handles a
+        # StartEpoch whose previous actives answer over the bulk channel
+        # (the shared coordinator's local fast path is disabled by stubbing
+        # get_final_state for the fetching side only)
+        fetcher = cl.actives[sorted(set(cfg.nodes.active_ids()) - old)[0]]
+        real_gfs = fetcher.coord.get_final_state
+        calls = {"n": 0}
+
+        def gfs_once_none(name, epoch):
+            calls["n"] += 1
+            return None if calls["n"] == 1 else real_gfs(name, epoch)
+
+        fetcher.coord = type(fetcher.coord).__new__(type(fetcher.coord))
+        fetcher.coord.__dict__.update(cl.coordinator.__dict__)
+        fetcher.coord.get_final_state = gfs_once_none
+        start = {
+            "type": "start_epoch", "name": "fat", "epoch": 1,
+            "actives": sorted(old), "initiator": "RC0",
+            "prev_epoch": 0, "prev_actives": sorted(old),
+            "initial_state": None,
+        }
+        fetcher._on_start_epoch("RC0", start)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if cl.coordinator.current_epoch("fat") == 1:
+                break
+            time.sleep(0.1)
+        assert cl.coordinator.current_epoch("fat") == 1
+        assert calls["n"] >= 1  # the remote path actually ran
+        # epoch 1 carries the big state fetched over bulk
+        assert c.request("fat", b"GET blob") == big.encode()
+    finally:
+        c.close()
+        cl.close()

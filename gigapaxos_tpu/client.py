@@ -96,8 +96,13 @@ class ReconfigurableAppClient:
         cb = None
         with self._lock:
             if rid is not None:
-                sa = self._sent_at.pop(rid, None)
-                if sa is not None:
+                sa = self._sent_at.get(rid)
+                if sa is not None and sa[0] == sender:
+                    # only credit the RTT to the node that actually answered:
+                    # with rid reuse across retries, a LATE response from
+                    # attempt k must not consume (and mis-attribute) the
+                    # timing entry written by attempt k+1
+                    del self._sent_at[rid]
                     node, t0 = sa
                     rtt = time.monotonic() - t0
                     prev = self._rtt.get(node)
@@ -230,31 +235,48 @@ class ReconfigurableAppClient:
                 tries: int = 4) -> bytes:
         """Sync request with redirection: on not_active/stopped, invalidate
         the cache, re-resolve and retry (the client's reconfiguration-chase
-        loop)."""
+        loop).
+
+        Retransmissions reuse the SAME rid, so a retry to the same active is
+        absorbed by its dedup cache instead of committing twice.  A retry to
+        a *different* active after a timeout is still at-least-once (the
+        original proposal may commit later), matching the reference client's
+        semantics — use idempotent requests or app-level dedup if that
+        matters.
+        """
         per = max(timeout / tries, 0.5)
         last = "timeout"
-        for attempt in range(tries):
-            try:
-                actives = self.request_actives(name, force=attempt > 0)
-            except ClientError as e:
-                raise ClientError(f"{name}: {e}") from e
-            target = self._pick_active(actives)
-            rid = self._rid()
+        rid = self._rid()  # one rid for every attempt (retransmission dedup)
+        try:
+            for attempt in range(tries):
+                try:
+                    actives = self.request_actives(name, force=attempt > 0)
+                except ClientError as e:
+                    raise ClientError(f"{name}: {e}") from e
+                target = self._pick_active(actives)
+                with self._lock:
+                    self._sent_at[rid] = (target, time.monotonic())
+                self.m.send(
+                    target, self._stamp(pkt.app_request(name, payload, rid))
+                )
+                try:
+                    resp = self._await(rid, per)
+                except TimeoutError:
+                    last = f"timeout via {target}"
+                    continue
+                if resp.get("ok"):
+                    return pkt.b64d(resp["response"]) or b""
+                last = resp.get("error", "error")
+                if last not in ("not_active", "stopped"):
+                    raise ClientError(f"{name}: {last}")
+                time.sleep(min(0.1 * (attempt + 1), 0.5))
+            raise TimeoutError(f"{name}: {last}")
+        finally:
+            # a late response from an earlier attempt's target leaves the
+            # newest _sent_at entry unconsumed (sender mismatch keeps it);
+            # the sync path owns this rid end-to-end, so always reap it
             with self._lock:
-                self._sent_at[rid] = (target, time.monotonic())
-            self.m.send(target, self._stamp(pkt.app_request(name, payload, rid)))
-            try:
-                resp = self._await(rid, per)
-            except TimeoutError:
-                last = f"timeout via {target}"
-                continue
-            if resp.get("ok"):
-                return pkt.b64d(resp["response"]) or b""
-            last = resp.get("error", "error")
-            if last not in ("not_active", "stopped"):
-                raise ClientError(f"{name}: {last}")
-            time.sleep(min(0.1 * (attempt + 1), 0.5))
-        raise TimeoutError(f"{name}: {last}")
+                self._sent_at.pop(rid, None)
 
     # ------------------------------------------------------------------ echo
     def echo(self, active: str, timeout: float = 5.0) -> float:

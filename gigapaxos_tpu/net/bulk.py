@@ -107,10 +107,18 @@ class BulkTransfer:
                      if now - e[3] > self.partial_ttl_s]
             for k in stale:
                 del self._rx[k]
+            if idx >= n:
+                # out-of-range chunk (corrupt/stray datagram): drop before
+                # touching the receive table — it must neither allocate an
+                # entry (spoofed unique keys would grow _rx until TTL GC),
+                # enter the chunk map (len(chunks)==n could then hold with a
+                # real index missing, wedging the completion join), nor
+                # reset an in-progress transfer
+                return
             ent = self._rx.get((sender, key))
             if ent is None:
                 ent = self._rx[(sender, key)] = [n, {}, 0, now]
-            if ent[0] != n or idx >= n:
+            if ent[0] != n:
                 # restarted transfer with different chunking: start over
                 ent = self._rx[(sender, key)] = [n, {}, 0, now]
             if idx not in ent[1]:

@@ -24,6 +24,7 @@ idempotent.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -108,6 +109,13 @@ class ActiveReplica:
         # (LargeCheckpointer analog, paxosutil/LargeCheckpointer.java:39)
         self.bulk = BulkTransfer(self.m)
         self.bulk.register_prefix("efs:", self._on_bulk_final_state)
+        # (client, rid) -> None while in flight, response packet once done;
+        # absorbs same-rid retransmissions (GCConcurrentHashMap analog)
+        self._req_dedup: "collections.OrderedDict[tuple, Optional[dict]]" = (
+            collections.OrderedDict()
+        )
+        self._dedup_cap = 4096
+        self._dedup_lock = threading.Lock()
         for ptype, h in [
             (pkt.APP_REQUEST, self._on_app_request),
             (pkt.STOP_EPOCH, self._on_stop_epoch),
@@ -127,39 +135,102 @@ class ActiveReplica:
     def _on_app_request(self, sender: str, p: dict) -> None:
         pkt.register_client(self.m.nodemap, p)
         name, rid = p["name"], p["rid"]
+        # retransmission dedup: the client reuses its rid on retry, so a
+        # duplicate arriving while the first copy is in flight is dropped
+        # (its response will carry the same rid) and one arriving after
+        # completion gets the cached response instead of a second proposal
+        key = (sender, rid)
+        with self._dedup_lock:
+            if key in self._req_dedup:
+                cached = self._req_dedup[key]
+                if cached is not None:
+                    self.m.send(sender, cached)
+                return
+            self._req_dedup[key] = None
+            if len(self._req_dedup) > self._dedup_cap:
+                # evict the oldest COMPLETED entry — dropping an in-flight
+                # (None) marker would let a retransmission of a slow request
+                # start the second proposal the map exists to prevent.  Scan
+                # stops at the first completed key (usually the very first),
+                # no full-copy of the map on the hot path.
+                victim = None
+                for k in self._req_dedup:
+                    if self._req_dedup[k] is not None:
+                        victim = k
+                        break
+                if victim is not None:
+                    del self._req_dedup[victim]
+        try:
+            self._handle_app_request(sender, p, key)
+        except Exception:
+            # never strand the in-flight marker: a parse error (e.g. corrupt
+            # base64 payload) must not black-hole every retransmission of
+            # this rid forever
+            with self._dedup_lock:
+                self._req_dedup.pop(key, None)
+            raise
+
+    def _handle_app_request(self, sender: str, p: dict, key) -> None:
+        name, rid = p["name"], p["rid"]
         epoch = self.coord.current_epoch(name)
         if epoch is None:
-            self.m.send(sender, {
+            self._finish_request(sender, key, {
                 "type": pkt.APP_RESPONSE, "rid": rid, "ok": False,
                 "error": "not_active", "name": name,
-            })
+            }, cache=False)
             return
         self._register_demand(name, sender, epoch)
         need = p.get("need_response", True)
 
         def cb(req_id: int, resp: Optional[bytes]) -> None:
             if not need:
+                # fire-and-forget: still resolve the marker (cache success so
+                # a retransmit doesn't re-commit; clear on failure)
+                ok = req_id >= 0 and resp is not None
+                with self._dedup_lock:
+                    if ok:
+                        self._req_dedup[key] = {"type": pkt.APP_RESPONSE,
+                                                "rid": rid, "ok": True,
+                                                "name": name}
+                    else:
+                        self._req_dedup.pop(key, None)
                 return
             if req_id < 0 or resp is None:
                 # epoch stopped underneath us: client must re-resolve actives
-                self.m.send(sender, {
+                self._finish_request(sender, key, {
                     "type": pkt.APP_RESPONSE, "rid": rid, "ok": False,
                     "error": "stopped", "name": name,
-                })
+                }, cache=False)
             else:
-                self.m.send(sender, {
+                self._finish_request(sender, key, {
                     "type": pkt.APP_RESPONSE, "rid": rid, "ok": True,
                     "name": name, "response": pkt.b64e(resp),
-                })
+                }, cache=True)
 
         r = self.coord.coordinate_request(
             name, epoch, pkt.b64d(p["payload"]) or b"", cb, entry=self.node_id
         )
-        if r is None and need:
-            self.m.send(sender, {
-                "type": pkt.APP_RESPONSE, "rid": rid, "ok": False,
-                "error": "not_active", "name": name,
-            })
+        if r is None:
+            if need:
+                self._finish_request(sender, key, {
+                    "type": pkt.APP_RESPONSE, "rid": rid, "ok": False,
+                    "error": "not_active", "name": name,
+                }, cache=False)
+            else:
+                with self._dedup_lock:
+                    self._req_dedup.pop(key, None)
+
+    def _finish_request(self, sender: str, key, packet: dict,
+                        cache: bool) -> None:
+        """Answer an app request.  Successful responses are cached for
+        retransmission replay; errors clear the pending marker so a retry
+        after e.g. an epoch change gets a fresh attempt."""
+        with self._dedup_lock:
+            if cache:
+                self._req_dedup[key] = packet
+            else:
+                self._req_dedup.pop(key, None)
+        self.m.send(sender, packet)
 
     def _register_demand(self, name: str, sender: str, epoch: int) -> None:
         with self._plock:

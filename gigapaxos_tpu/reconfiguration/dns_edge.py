@@ -41,12 +41,19 @@ class DnsReconfigurator:
         zone: str = "gp",
         ttl: int = 30,
         policy: DnsTrafficPolicy = default_policy,
+        max_workers: int = 16,
     ):
         self.client = client
         self.zone = zone.strip(".")
         self.ttl = ttl
         self.policy = policy
         self._host_cache: Dict[str, Tuple[float, Optional[str]]] = {}
+        # bounded worker pool: UDP queries are spoofable, so per-query
+        # unbounded threads are a trivial resource-exhaustion vector; when
+        # every worker is busy (each may hold a synchronous RC round trip)
+        # excess queries are dropped — resolvers retry
+        self._workers = threading.Semaphore(max_workers)
+        self.stats = {"dropped": 0}
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self.sock.bind(bind)
         self.sock.settimeout(0.25)
@@ -74,20 +81,33 @@ class DnsReconfigurator:
             # per-query worker: a cache-miss resolve is a synchronous RC
             # round trip, and one slow name must not stall every other
             # resolver (the client's actives cache keeps the hot path local)
-            threading.Thread(
-                target=self._handle_one, args=(data, addr), daemon=True
-            ).start()
+            if not self._workers.acquire(blocking=False):
+                self.stats["dropped"] += 1
+                continue
+            try:
+                threading.Thread(
+                    target=self._handle_one, args=(data, addr), daemon=True
+                ).start()
+            except RuntimeError:
+                # thread spawn failed (fd/thread exhaustion — the very
+                # overload this bound guards): return the permit or the pool
+                # shrinks permanently
+                self._workers.release()
+                self.stats["dropped"] += 1
 
     def _handle_one(self, data: bytes, addr) -> None:
         try:
-            resp = self._answer(data)
-        except Exception:
-            return  # malformed query: drop
-        if resp is not None:
             try:
-                self.sock.sendto(resp, addr)
-            except OSError:
-                pass
+                resp = self._answer(data)
+            except Exception:
+                return  # malformed query: drop
+            if resp is not None:
+                try:
+                    self.sock.sendto(resp, addr)
+                except OSError:
+                    pass
+        finally:
+            self._workers.release()
 
     def _resolve(self, qname: str) -> Tuple[str, Optional[List[str]]]:
         """-> ("ok", ips) | ("nxdomain", None) | ("servfail", None).

@@ -191,7 +191,15 @@ class DistTransactor:
 
         def cb(*args) -> None:
             # server SPI callbacks are (rid, resp); client ones may be (resp)
-            box[0] = args[-1]
+            r = args[-1]
+            if isinstance(r, dict):
+                # client binding (send_request) delivers the raw response
+                # packet {ok, response(b64), error}; unwrap to the app payload
+                # so the TX_OK/TX_LOCKED comparisons below see real bytes
+                from ..reconfiguration import packets as pkt
+
+                r = (pkt.b64d(r.get("response")) or b"") if r.get("ok") else None
+            box[0] = r
             ev.set()
 
         r = self.coordinate(name, payload, cb)

@@ -59,3 +59,22 @@ class RowAllocator:
 
     def items(self):
         return self._name_to_row.items()
+
+    def restore(self, rows: Dict[str, int], free_rows=None) -> None:
+        """Reset to a snapshot: name->row map plus the VERBATIM free-list.
+
+        The LIFO order of ``free_rows`` must survive recovery — journal
+        replay re-allocates rows with ``pop()`` and row-addressed tick
+        records only land correctly if replay allocates the same rows the
+        live run did.  ``free_rows=None`` (pre-free_rows snapshots)
+        reconstructs best-effort in the initial descending order.
+        """
+        self._name_to_row = dict(rows)
+        self._row_to_name = {row: name for name, row in rows.items()}
+        if free_rows is not None:
+            self._free = list(free_rows)
+        else:
+            used = set(rows.values())
+            self._free = [
+                r for r in range(self.capacity - 1, -1, -1) if r not in used
+            ]

@@ -25,6 +25,9 @@ class ChainLogger(PaxosLogger):
             "tick_num": m.tick_num,
             "next_rid": m._next_rid,
             "rows": dict(m.rows.items()),
+            # verbatim LIFO free-list — see PaxosLogger._meta for why order
+            # must survive recovery
+            "free_rows": list(m.rows._free),
             "stopped_rows": set(m._stopped_rows),
             "outstanding": [
                 (r.rid, r.name, r.row, r.payload, r.stop,
@@ -61,10 +64,7 @@ def recover_chain(cfg, n_replicas: int, apps, log_dir: str, native: bool = True)
         )
         m.tick_num = meta["tick_num"]
         m._next_rid = meta["next_rid"]
-        for name, row in meta["rows"].items():
-            m.rows._name_to_row[name] = row
-            m.rows._row_to_name[row] = name
-            m.rows._free.remove(row)
+        m.rows.restore(meta["rows"], meta.get("free_rows"))
         m._stopped_rows = set(meta["stopped_rows"])
         for rid, name, row, payload, stop, eby, responded in meta["outstanding"]:
             # executed_by was an int count in snapshots written before it

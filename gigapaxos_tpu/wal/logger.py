@@ -152,6 +152,11 @@ class PaxosLogger:
             "tick_num": m.tick_num,
             "next_rid": m._next_rid,
             "rows": dict(m.rows.items()),
+            # verbatim LIFO free-list: replayed OP_CREATE/OP_UNPAUSE must
+            # allocate the SAME rows the live run did (journaled OP_TICK
+            # records address groups by row); reconstructing the free list
+            # from rows alone loses the pop order after pause/remove churn
+            "free_rows": list(m.rows._free),
             "stopped_rows": set(m._stopped_rows),
             "seen": {k: list(v.items()) for k, v in m._seen.items()},
             "outstanding": [
@@ -302,10 +307,7 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True):
         m.state = PaxosState(**{f: jnp.asarray(arrs[f]) for f in PaxosState._fields})
         m.tick_num = meta["tick_num"]
         m._next_rid = meta["next_rid"]
-        for name, row in meta["rows"].items():
-            m.rows._name_to_row[name] = row
-            m.rows._row_to_name[row] = name
-            m.rows._free.remove(row)
+        m.rows.restore(meta["rows"], meta.get("free_rows"))
         m._stopped_rows = set(meta["stopped_rows"])
         for k, items in meta["seen"].items():
             od = collections.OrderedDict(items)

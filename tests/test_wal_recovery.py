@@ -124,6 +124,39 @@ def test_recovery_idempotent_double_crash(tmp_path):
     m3.wal.close()
 
 
+def test_recovery_preserves_free_list_order(tmp_path):
+    """Pause churn reorders the row free-list (LIFO); a checkpoint taken then
+    must restore it verbatim, or journaled OP_UNPAUSE replay re-allocates
+    different rows than the live run and row-addressed OP_TICK placements
+    land on the wrong groups (silently losing committed writes)."""
+    cfg, apps, m = mk(tmp_path)
+    drive(m, n_names=3, n_reqs=2)  # kv0,kv1,kv2 on rows 0,1,2; quiescent
+    m._sweep_outstanding()
+    # free rows 0 then 1 -> free list tail is [..., 0, 1], next alloc pops 1
+    m._do_pause(["kv0", "kv1"])
+    m.wal.log_pause(["kv0", "kv1"])
+    m.wal.checkpoint()
+    # transparently unpauses kv0 -- live run places it on row 1
+    done = []
+    m.propose("kv0", b"PUT pk pv", lambda _r, resp: done.append(resp))
+    m.run_ticks(3)
+    assert done == [b"OK"]
+    assert m.rows.row("kv0") == 1
+    db_before = [dict(a.db) for a in apps]
+    m.wal.close()  # crash after the PUT committed + was acked
+
+    apps2 = [KVApp() for _ in range(3)]
+    m2 = recover(cfg, 3, apps2, str(tmp_path), native=False)
+    assert m2.rows.row("kv0") == 1  # same row as the live run
+    for r in range(3):
+        assert apps2[r].db == db_before[r]
+    got = []
+    m2.propose("kv0", b"GET pk", lambda _r, resp: got.append(resp))
+    m2.run_ticks(3)
+    assert got == [b"pv"]  # the committed PUT survived recovery
+    m2.wal.close()
+
+
 def test_native_journal_parity(tmp_path):
     """C++ journal writes the byte-identical format (shared reader), repairs
     torn tails, and interoperates with the Python writer."""

@@ -1,0 +1,25 @@
+"""Mode B: independent per-node consensus processes over the transport.
+
+Mode A (``paxos/manager.py``) drives a whole replica set as one device
+program — replica-axis traffic is ICI collectives.  Mode B gives every node
+its own process, device state and WAL, with replica traffic as SoA frames
+over the DCN transport — the reference's deployment shape
+(``ReconfigurableNode`` per machine, reconfiguration/ReconfigurableNode.java:63).
+"""
+
+from .kernel import node_tick, node_tick_impl
+from .logger import ModeBLogger, recover_modeb
+from .manager import ModeBNode, rid_origin
+from .wire import decode_frame, encode_frame, gid_of
+
+__all__ = [
+    "ModeBLogger",
+    "ModeBNode",
+    "decode_frame",
+    "encode_frame",
+    "gid_of",
+    "node_tick",
+    "node_tick_impl",
+    "recover_modeb",
+    "rid_origin",
+]

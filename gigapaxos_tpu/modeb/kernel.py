@@ -1,0 +1,88 @@
+"""Per-node consensus step for Mode B (independent processes per replica).
+
+Mode A runs the whole replica set as one device program (``ops/tick.py``);
+Mode B gives every node its own process, disk and device state — the
+reference's actual deployment shape (one ``PaxosManager`` per machine,
+gigapaxos/PaxosManager.java:104-119, replica traffic over NIO,
+nio/NIOTransport.java:65-114).
+
+Design: each node holds the full ``[R, ...]`` state arrays but is
+**authoritative only for its own row r**.  Peer rows are *mirrors*, updated
+exclusively by replica frames received over the transport (``wire.py``).
+The node step reuses the verified fused dataflow (``paxos_tick_impl``) and
+then keeps only row r of the result — peer rows stay whatever the last
+frames said.
+
+Why this is safe with stale mirrors: every cross-replica read in the fused
+tick consumes *monotone facts* —
+
+* a promise in a mirror row means that acceptor really promised that ballot
+  at its frame snapshot (promises only rise), so counting a prepare
+  majority from mirrors counts real promises, and the carryover window
+  rides the same snapshot (= "accepteds as of the promise", the classic
+  prepare-reply content, PaxosInstanceStateMachine.java:1017);
+* a vote (accepted pvalue) in a mirror is a historical fact: once a
+  majority ever accepted (slot, ballot, value), that value is chosen —
+  tallying stale votes can only *under*-count, never fabricate a quorum;
+* decisions are facts by construction.
+
+Staleness therefore costs latency (a decision needs a frame round-trip to
+gather votes), never agreement.  The one hazard is the intake phase: the
+fused tick may assign a request to a *peer* coordinator's proposal ring,
+which this wrapper then discards — the host must treat intake as accepted
+only when ``out.coord_id[row] == r`` and otherwise re-queue/forward
+(``manager.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.tick import TickInbox, paxos_tick_impl
+
+#: own-row state fields shipped in replica frames ([R, G] / [R, W, G])
+FRAME_FIELDS_2D = ("exec_slot", "bal_num", "bal_coord", "status",
+                   "coord_active", "coord_preparing", "coord_bnum",
+                   "next_slot")
+FRAME_FIELDS_3D = ("acc_bnum", "acc_bcoord", "acc_req", "acc_slot",
+                   "acc_stop", "dec_req", "dec_slot", "dec_valid",
+                   "dec_stop", "prop_req", "prop_slot", "prop_valid",
+                   "prop_stop")
+
+
+def node_tick_impl(state, inbox: TickInbox, r: int):
+    """One Mode-B node step: fused dataflow, own-row commit, change mask.
+
+    Returns (state', outbox, changed[G]) where ``changed`` marks groups
+    whose own-row frame fields differ from before (the delta-frame mask —
+    the batching analog of PaxosPacketBatcher coalescing per-peer traffic,
+    gigapaxos/PaxosPacketBatcher.java:28-35).
+    """
+    new, out = paxos_tick_impl(state, inbox)
+    R = state.exec_slot.shape[0]
+    row2 = (jnp.arange(R) == r)[:, None]        # [R, 1]
+    row3 = row2[:, None, :]                      # [R, 1, 1]
+
+    merged = {}
+    changed = jnp.zeros(state.exec_slot.shape[1], jnp.bool_)
+    for f in FRAME_FIELDS_2D:
+        old_a, new_a = getattr(state, f), getattr(new, f)
+        merged[f] = jnp.where(row2, new_a, old_a)
+        changed = changed | (new_a[r] != old_a[r])
+    for f in FRAME_FIELDS_3D:
+        old_a, new_a = getattr(state, f), getattr(new, f)
+        merged[f] = jnp.where(row3, new_a, old_a)
+        changed = changed | jnp.any(new_a[r] != old_a[r], axis=0)
+    # member/n_members/epoch are config state managed by create/free ops,
+    # identical on every node — the tick never writes them
+    return state._replace(**merged), out, changed
+
+
+@functools.lru_cache(maxsize=None)
+def node_tick(r: int):
+    """Jitted per-node step (r static; state donated)."""
+    return jax.jit(functools.partial(node_tick_impl, r=r),
+                   donate_argnums=(0,))

@@ -1,0 +1,221 @@
+"""Per-node WAL + recovery for Mode B.
+
+Each Mode-B node owns an independent journal+snapshot WAL — the reference's
+one-log-per-machine shape (``SQLPaxosLogger`` instantiated per node,
+gigapaxos/SQLPaxosLogger.java:123) rather than Mode A's single shared log.
+
+The node step is deterministic given (state, applied frames, placed intake,
+alive mask), so the journal records exactly those inputs in arrival order:
+
+* OP_CREATE / OP_REMOVE — admin ops;
+* OP_FRAME — every replica frame applied to the peer mirrors (raw bytes,
+  already a compact SoA encoding);
+* OP_TICK — the placed intake of one step, with payloads, plus the alive
+  mask.
+
+Recovery = snapshot + in-order replay of these records through the same
+jitted kernel (the 3-pass recovery analog, PaxosManager.java:1852-2055),
+after which the node re-wires its transport and asks peers for anti-entropy
+full frames (``request_sync``) to refresh its mirrors.
+"""
+
+from __future__ import annotations
+
+import glob
+import io
+import os
+import pickle
+
+import numpy as np
+
+from ..wal.logger import OP_CREATE, OP_REMOVE, OP_TICK, PaxosLogger
+
+OP_FRAME = 6
+OP_CKPT = 7
+
+
+class ModeBLogger(PaxosLogger):
+    def log_frame(self, payload: bytes) -> None:
+        """Journal an applied replica frame (before mirror mutation; rides
+        the next tick's group commit for fsync)."""
+        self.journal.append(pickle.dumps((OP_FRAME, payload)))
+
+    def log_ckpt(self, gid: int, packet: dict) -> None:
+        """Journal an adopted checkpoint transfer — it mutates own-row state
+        outside the deterministic tick, so replay must re-apply it."""
+        self.journal.append(pickle.dumps((OP_CKPT, gid, dict(packet))))
+        self.journal.sync()
+
+    def log_inbox(self, tick_num: int, inbox) -> None:
+        m = self.manager
+        placed = []
+        for row, take in m._placed:
+            entries = []
+            for rid, p in take:
+                rec = m.outstanding.get(rid)
+                if rec is not None:
+                    entries.append((rid, p, rec.payload, rec.stop))
+                elif rid in m.payloads:
+                    pl, stop = m.payloads[rid]
+                    entries.append((rid, p, pl, stop))
+            if entries:
+                placed.append((row, entries))
+        alive = np.asarray(inbox.alive).tobytes()
+        self.journal.append(
+            pickle.dumps((OP_TICK, tick_num, placed, alive))
+        )
+        self._ticks_since_sync += 1
+        if self._ticks_since_sync >= self.sync_every:
+            self.journal.sync()
+            self._ticks_since_sync = 0
+
+    def _meta(self, m) -> dict:
+        return {
+            "tick_num": m.tick_num,
+            "next_seq": m._next_seq,
+            "rows": dict(m.rows.items()),
+            "free_rows": list(m.rows._free),
+            "row_meta": dict(m._row_meta),
+            "stopped_rows": set(m._stopped_rows),
+            "tainted_rows": set(m._tainted_rows),
+            "seen": {k: list(v.items()) for k, v in m._seen.items()},
+            "payloads": list(m.payloads.items()),
+            "outstanding": [
+                (r.rid, r.name, r.row, r.payload, r.stop, r.responded,
+                 r.born_tick)
+                for r in m.outstanding.values()
+            ],
+            "queues": {row: list(q) for row, q in m._queues.items() if q},
+            "coord_view": m._coord_view.tobytes(),
+            "frame_applied": dict(m._frame_applied_tick),
+            "app": {name: m.app.checkpoint(name) for name in m.rows.names()},
+        }
+
+
+def recover_modeb(cfg, member_ids, node_id, app, log_dir: str,
+                  native: bool = True):
+    """Rebuild a ModeBNode from its own disk; attach a messenger and call
+    ``request_sync()`` afterwards to rejoin the replica set."""
+    import collections
+
+    import jax.numpy as jnp
+
+    from ..ops.tick import TickInbox
+    from ..paxos.state import PaxosState
+    from ..wal.journal import read_journal
+    from . import wire
+    from .manager import ModeBNode, ModeBRecord, rid_origin, RID_MASK
+
+    logger = ModeBLogger(log_dir, native=native)
+    node = ModeBNode(cfg, member_ids, node_id, app)  # no messenger, no wal
+    snap_seq = logger._latest_snapshot_seq()
+    start_seq = 0
+    if snap_seq is not None:
+        with open(logger._snapshot_path(snap_seq), "rb") as f:
+            meta, npz_blob = pickle.loads(f.read())
+        arrs = np.load(io.BytesIO(npz_blob))
+        node.state = PaxosState(
+            **{f: jnp.asarray(arrs[f]) for f in PaxosState._fields}
+        )
+        node.tick_num = meta["tick_num"]
+        node._next_seq = meta["next_seq"]
+        node.rows.restore(meta["rows"], meta["free_rows"])
+        node._gid_row = {wire.gid_of(n): row for n, row in meta["rows"].items()}
+        node._row_meta = dict(meta["row_meta"])
+        node._stopped_rows = set(meta["stopped_rows"])
+        node._tainted_rows = set(meta.get("tainted_rows", ()))
+        for k, items in meta["seen"].items():
+            node._seen[k] = collections.OrderedDict(items)
+        for rid, pl in meta["payloads"]:
+            node.payloads[rid] = pl
+        for rid, name, row, payload, stop, responded, born in meta[
+            "outstanding"
+        ]:
+            rec = ModeBRecord(rid, name, row, payload, stop, None, born)
+            rec.responded = responded
+            node.outstanding[rid] = rec
+        for row, rids in meta["queues"].items():
+            node._queues[int(row)] = collections.deque(rids)
+        node._coord_view = np.frombuffer(
+            meta["coord_view"], dtype=np.int32
+        ).copy()
+        node._frame_applied_tick = dict(meta["frame_applied"])
+        for name, blob in meta["app"].items():
+            node.app.restore(name, blob)
+        start_seq = snap_seq
+
+    for path in sorted(glob.glob(os.path.join(log_dir, "journal.*.log"))):
+        seq = int(os.path.basename(path).split(".")[1])
+        if seq < start_seq:
+            continue
+        for raw in read_journal(path):
+            rec = pickle.loads(raw)
+            op = rec[0]
+            if op == OP_CREATE:
+                _, name, members, epoch = rec
+                if name not in node.rows:
+                    node.create_group(name, members, epoch)
+            elif op == OP_REMOVE:
+                node.remove_group(rec[1])
+            elif op == OP_FRAME:
+                try:
+                    node._apply_frame(wire.decode_frame(rec[1]))
+                except (ValueError, IndexError):
+                    pass  # tolerate a frame torn by the crash
+            elif op == OP_CKPT:
+                _, gid, packet = rec
+                row = node._gid_row.get(gid)
+                if row is not None:
+                    node._apply_ckpt(row, packet)
+            elif op == OP_TICK:
+                _, tick_num, placed, alive_b = rec
+                if tick_num < node.tick_num:
+                    continue  # already inside the snapshot
+                req = np.zeros((node.R, node.P, node.G), np.int32)
+                stp = np.zeros((node.R, node.P, node.G), bool)
+                node._placed = []
+                for row, entries in placed:
+                    take = []
+                    placed_rids = set()
+                    for rid, p, payload, stop in entries:
+                        if rid_origin(rid) == node.r:
+                            node._next_seq = max(
+                                node._next_seq, (rid & RID_MASK) + 1
+                            )
+                        placed_rids.add(rid)
+                        if (rid not in node.outstanding
+                                and rid not in node.payloads):
+                            node._store_payload(rid, payload, stop)
+                        req[node.r, p, row] = rid
+                        stp[node.r, p, row] = stop
+                        take.append((rid, p))
+                    node._placed.append((row, take))
+                    # snapshot queues may hold copies of rids whose placement
+                    # is journaled after it — drop or they commit twice
+                    if row in node._queues and placed_rids:
+                        node._queues[row] = collections.deque(
+                            r for r in node._queues[row]
+                            if r not in placed_rids
+                        )
+                alive = np.frombuffer(alive_b, dtype=bool)
+                inbox = TickInbox(jnp.asarray(req), jnp.asarray(stp),
+                                  jnp.asarray(alive))
+                node.state, out, changed = node._tick(node.state, inbox)
+                node._process_outbox(out)
+                node._dirty |= np.asarray(changed)
+                node.tick_num = tick_num + 1
+
+    node._held_callbacks = []  # no live clients to answer during replay
+    # close the rid-regression hole: every rid that could ever commit is
+    # visible in some ring or payload/outstanding table — never hand out a
+    # sequence number at or below any of them
+    for f in ("acc_req", "dec_req", "prop_req"):
+        node.bump_seq(np.asarray(getattr(node.state, f)))
+    node.bump_seq(np.fromiter(node.payloads.keys(), np.int64,
+                              len(node.payloads)))
+    node.bump_seq(np.fromiter(node.outstanding.keys(), np.int64,
+                              len(node.outstanding)))
+    logger.attach(node)
+    node.wal = logger
+    node._force_full = True  # re-announce our row to peers on rejoin
+    return node

@@ -1,0 +1,169 @@
+"""Fixed-width SoA wire format for Mode-B replica traffic.
+
+This is the ``paxospackets`` analog (SURVEY §2.1 wire-schema row;
+gigapaxos/paxospackets/PaxosPacket.java:202-291) re-expressed for the dense
+design: instead of 17 per-event packet classes, one **replica frame** per
+tick carries every protocol message a node emits, as struct-of-arrays int32
+columns over its changed group rows:
+
+* PREPARE        -> (flags.PREPARING, coord_bnum)              per group
+* PROMISE        -> (bal_num, bal_coord)                       per group
+* ACCEPT         -> (flags.COORD_ACTIVE, prop_* ring)          per group
+  (batched, like BatchedAccept, gigapaxos/PaxosPacketBatcher.java:28-35)
+* ACCEPT_REPLY   -> (acc_* ring: the acceptor's vote ledger)   per group
+* DECISION       -> (dec_* ring)                               per group
+* checkpoint/gap -> (exec_slot, status)                        per group
+
+plus an out-of-band payload table (request-id -> bytes) for requests the
+sender newly proposed, so every learner holds payloads before it executes
+(the reference ships full requests inside ACCEPT/DECISION,
+gigapaxos/paxospackets/RequestPacket.java:189-233).
+
+Groups are addressed by a 63-bit name hash (``gid``) so independent nodes
+agree on addressing without a shared row allocator; each receiver maps gid
+-> its own local row.  A reserved per-group ``digest`` column keeps the
+protocol slot for digest-only accepts (PendingDigests,
+gigapaxos/paxosutil/PendingDigests.java:23) without implementing them yet.
+
+Layout (little-endian):
+
+  header:  MAGIC 'GPXB' | u16 version | u16 W | i32 sender_r | i64 tick
+           | u8 full (anti-entropy full-state frame) | i32 n | i32 n_payload
+  columns: u64 gid[n]
+           i32 {exec_slot,bal_num,bal_coord,status,coord_bnum,next_slot,
+                flags,digest}[n]
+           i32 {acc_bnum,acc_bcoord,acc_req,acc_slot,
+                dec_req,dec_slot,prop_req,prop_slot}[n*W]   (group-major)
+           i32 {ringbits}[n]  -- acc_stop,dec_valid,dec_stop,prop_valid,
+                                 prop_stop packed 5*W bits? no: one i32 per
+                                 ring-bit field per group (W<=31 bits each)
+  payload table: n_payload x (i32 rid | u8 stop | u32 len | bytes)
+
+Everything but the payload table encodes/decodes as vectorized numpy
+``tobytes``/``frombuffer`` — no per-group Python work.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from typing import Dict, List, NamedTuple, Tuple
+
+import numpy as np
+
+MAGIC = b"GPXB"
+VERSION = 1
+
+FLAG_COORD_ACTIVE = 1
+FLAG_COORD_PREPARING = 2
+
+#: [R, G] scalar columns shipped per group (+ flags packed separately)
+SCALARS = ("exec_slot", "bal_num", "bal_coord", "status", "coord_bnum",
+           "next_slot")
+#: [R, W, G] int32 ring columns
+RINGS = ("acc_bnum", "acc_bcoord", "acc_req", "acc_slot",
+         "dec_req", "dec_slot", "prop_req", "prop_slot")
+#: [R, W, G] bool ring columns, packed W bits -> one i32 per group
+RING_BITS = ("acc_stop", "dec_valid", "dec_stop", "prop_valid", "prop_stop")
+
+_HDR = struct.Struct("<4sHHiqBii")
+_PAY = struct.Struct("<iBI")
+
+
+def gid_of(name: str) -> int:
+    """Stable 63-bit group id from the service name (the IntegerMap analog
+    for cross-node addressing, gigapaxos/paxosutil/IntegerMap.java:40 —
+    except interning must agree across nodes, hence a hash, not a counter)."""
+    h = hashlib.blake2b(name.encode(), digest_size=8).digest()
+    return int.from_bytes(h, "little") & 0x7FFFFFFFFFFFFFFF
+
+
+class Frame(NamedTuple):
+    sender_r: int
+    tick: int
+    W: int
+    full: bool
+    gids: np.ndarray              # u64 [n]
+    scalars: Dict[str, np.ndarray]  # name -> i32 [n]
+    flags: np.ndarray             # i32 [n]
+    digest: np.ndarray            # i32 [n] (reserved protocol slot)
+    rings: Dict[str, np.ndarray]  # name -> i32 [n, W]
+    ring_bits: Dict[str, np.ndarray]  # name -> bool [n, W]
+    payloads: List[Tuple[int, bool, bytes]]  # (rid, stop, payload)
+
+
+def pack_bits(b: np.ndarray) -> np.ndarray:
+    """bool [n, W] -> i32 [n] (bit j = plane j); W <= 31."""
+    n, W = b.shape
+    assert W <= 31, "ring window too deep for bit-packed wire columns"
+    weights = (1 << np.arange(W, dtype=np.int64))[None, :]
+    return (b.astype(np.int64) * weights).sum(axis=1).astype(np.int32)
+
+
+def unpack_bits(v: np.ndarray, W: int) -> np.ndarray:
+    """i32 [n] -> bool [n, W]."""
+    return (v[:, None] >> np.arange(W, dtype=np.int32)[None, :]) & 1 > 0
+
+
+def encode_frame(
+    sender_r: int,
+    tick: int,
+    W: int,
+    gids: np.ndarray,
+    scalars: Dict[str, np.ndarray],
+    flags: np.ndarray,
+    rings: Dict[str, np.ndarray],
+    ring_bits: Dict[str, np.ndarray],
+    payloads: List[Tuple[int, bool, bytes]],
+    full: bool = False,
+    digest: np.ndarray = None,
+) -> bytes:
+    n = len(gids)
+    parts = [
+        _HDR.pack(MAGIC, VERSION, W, sender_r, tick, int(full), n,
+                  len(payloads)),
+        np.ascontiguousarray(gids, dtype=np.uint64).tobytes(),
+    ]
+    for f in SCALARS:
+        parts.append(np.ascontiguousarray(scalars[f], np.int32).tobytes())
+    parts.append(np.ascontiguousarray(flags, np.int32).tobytes())
+    if digest is None:
+        digest = np.zeros(n, np.int32)
+    parts.append(np.ascontiguousarray(digest, np.int32).tobytes())
+    for f in RINGS:
+        parts.append(np.ascontiguousarray(rings[f], np.int32).tobytes())
+    for f in RING_BITS:
+        parts.append(pack_bits(ring_bits[f]).tobytes())
+    for rid, stop, data in payloads:
+        parts.append(_PAY.pack(rid, int(stop), len(data)))
+        parts.append(data)
+    return b"".join(parts)
+
+
+def decode_frame(buf: bytes) -> Frame:
+    magic, ver, W, sender_r, tick, full, n, n_pay = _HDR.unpack_from(buf, 0)
+    if magic != MAGIC or ver != VERSION:
+        raise ValueError("bad replica frame header")
+    off = _HDR.size
+
+    def col(dtype, count):
+        nonlocal off
+        nbytes = np.dtype(dtype).itemsize * count
+        a = np.frombuffer(buf, dtype=dtype, count=count, offset=off)
+        off += nbytes
+        return a
+
+    gids = col(np.uint64, n)
+    scalars = {f: col(np.int32, n) for f in SCALARS}
+    flags = col(np.int32, n)
+    digest = col(np.int32, n)
+    rings = {f: col(np.int32, n * W).reshape(n, W) for f in RINGS}
+    ring_bits = {f: unpack_bits(col(np.int32, n), W) for f in RING_BITS}
+    payloads: List[Tuple[int, bool, bytes]] = []
+    for _ in range(n_pay):
+        rid, stop, ln = _PAY.unpack_from(buf, off)
+        off += _PAY.size
+        payloads.append((rid, bool(stop), buf[off: off + ln]))
+        off += ln
+    return Frame(sender_r, tick, W, bool(full), gids, scalars, flags, digest,
+                 rings, ring_bits, payloads)

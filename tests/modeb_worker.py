@@ -1,0 +1,99 @@
+"""Mode-B worker process for the multi-process test (one consensus node per
+OS process — the reference's real deployment unit, ReconfigurableNode.main,
+reconfiguration/ReconfigurableNode.java:434).
+
+Line protocol on stdin/stdout:
+  create <name>            -> "created <name>"
+  propose <name> <hex>     -> (async) "resp <rid> <hex|NONE>"
+  db                       -> "db <json>"
+  ready                    -> "ready" (after first tick: kernel compiled)
+  exit                     -> process exits cleanly
+The node ticks continuously on a background thread.  SIGKILL the process to
+emulate machine death; restart with the same WAL dir to exercise recovery.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from gigapaxos_tpu.config import GigapaxosTpuConfig  # noqa: E402
+from gigapaxos_tpu.models.replicable import KVApp  # noqa: E402
+from gigapaxos_tpu.modeb import ModeBLogger, ModeBNode, recover_modeb  # noqa: E402
+from gigapaxos_tpu.net.messenger import Messenger, NodeMap  # noqa: E402
+
+
+def main() -> None:
+    node_id = sys.argv[1]
+    topology = json.loads(sys.argv[2])  # {node_id: [host, port]}
+    wal_dir = sys.argv[3]
+    ids = sorted(topology)
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 16
+
+    nodemap = NodeMap()
+    for nid, (host, port) in topology.items():
+        nodemap.add(nid, host, int(port))
+
+    app = KVApp()
+    out_lock = threading.Lock()
+
+    def emit(line: str) -> None:
+        with out_lock:
+            sys.stdout.write(line + "\n")
+            sys.stdout.flush()
+
+    recovering = os.path.exists(wal_dir) and os.listdir(wal_dir)
+    if recovering:
+        node = recover_modeb(cfg, ids, node_id, app, wal_dir, native=False)
+        m = Messenger(node_id, tuple(topology[node_id]), nodemap)
+        node.attach_messenger(m)
+        node.request_sync()
+    else:
+        m = Messenger(node_id, tuple(topology[node_id]), nodemap)
+        wal = ModeBLogger(wal_dir, native=False)
+        node = ModeBNode(cfg, ids, node_id, app, m, wal=wal)
+
+    stop = threading.Event()
+
+    def pump() -> None:
+        node.tick()
+        emit("ready")
+        while not stop.is_set():
+            node.tick()
+            time.sleep(0.004)
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    for line in sys.stdin:
+        parts = line.strip().split(" ")
+        if not parts or not parts[0]:
+            continue
+        cmd = parts[0]
+        if cmd == "create":
+            node.create_group(parts[1], list(range(len(ids))))
+            emit(f"created {parts[1]}")
+        elif cmd == "propose":
+            name, payload = parts[1], bytes.fromhex(parts[2])
+
+            def cb(rid, resp, _n=name):
+                emit(f"resp {rid} {resp.hex() if resp is not None else 'NONE'}")
+
+            node.propose(name, payload, cb)
+        elif cmd == "db":
+            emit("db " + json.dumps(app.db, sort_keys=True))
+        elif cmd == "exit":
+            break
+    stop.set()
+    node.close()
+
+
+if __name__ == "__main__":
+    main()

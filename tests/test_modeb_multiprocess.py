@@ -1,0 +1,136 @@
+"""Mode B across REAL OS processes: 3 nodes, SIGKILL one, majority commits,
+restart it from its own journal — the reference's machine-failure story
+(kill a gigapaxos server process, restart, SQLPaxosLogger recovery) run
+end-to-end with nothing shared but TCP."""
+
+import json
+import os
+import queue
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+WORKER = os.path.join(os.path.dirname(__file__), "modeb_worker.py")
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class Worker:
+    def __init__(self, node_id, topology, wal_dir):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(WORKER))
+        env.pop("JAX_PLATFORMS", None)
+        self.node_id = node_id
+        self.proc = subprocess.Popen(
+            [sys.executable, WORKER, node_id, json.dumps(topology), wal_dir],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, env=env,
+        )
+        self.lines: "queue.Queue[str]" = queue.Queue()
+        threading.Thread(target=self._read, daemon=True).start()
+
+    def _read(self) -> None:
+        for line in self.proc.stdout:
+            self.lines.put(line.strip())
+
+    def send(self, cmd: str) -> None:
+        self.proc.stdin.write(cmd + "\n")
+        self.proc.stdin.flush()
+
+    def expect(self, prefix: str, timeout: float = 60.0) -> str:
+        deadline = time.monotonic() + timeout
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise TimeoutError(f"{self.node_id}: no '{prefix}' line")
+            try:
+                line = self.lines.get(timeout=left)
+            except queue.Empty:
+                continue
+            if line.startswith(prefix):
+                return line
+
+    def db(self, timeout: float = 30.0) -> dict:
+        self.send("db")
+        return json.loads(self.expect("db ", timeout)[3:])
+
+    def sigkill(self) -> None:
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=10)
+
+    def close(self) -> None:
+        if self.proc.poll() is None:
+            try:
+                self.send("exit")
+                self.proc.wait(timeout=10)
+            except (OSError, subprocess.TimeoutExpired):
+                self.proc.kill()
+
+
+@pytest.mark.slow
+def test_three_processes_sigkill_and_recover(tmp_path):
+    ids = ["P0", "P1", "P2"]
+    topology = {nid: ["127.0.0.1", free_port()] for nid in ids}
+    workers = {
+        nid: Worker(nid, topology, str(tmp_path / nid)) for nid in ids
+    }
+    try:
+        for w in workers.values():
+            w.expect("ready", timeout=180)  # per-process kernel compile
+        workers["P0"].send("create svc")
+        workers["P0"].expect("created")
+        workers["P1"].send("create svc")
+        workers["P1"].expect("created")
+        workers["P2"].send("create svc")
+        workers["P2"].expect("created")
+
+        workers["P1"].send(f"propose svc {b'PUT a 1'.hex()}")
+        assert workers["P1"].expect("resp ", 60).endswith(b"OK".hex())
+
+        # every process's app converges
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(w.db().get("svc", {}).get("a") == "1"
+                   for w in workers.values()):
+                break
+            time.sleep(0.25)
+        else:
+            raise AssertionError("apps did not converge across processes")
+
+        # ---- kill -9 a real process; the majority keeps committing
+        workers["P2"].sigkill()
+        workers["P1"].send(f"propose svc {b'PUT b 2'.hex()}")
+        assert workers["P1"].expect("resp ", 90).endswith(b"OK".hex())
+
+        # ---- restart from ITS OWN journal; it recovers and catches up
+        workers["P2"] = Worker("P2", topology, str(tmp_path / "P2"))
+        workers["P2"].expect("ready", timeout=180)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            db = workers["P2"].db()
+            if db.get("svc", {}).get("a") == "1" and \
+               db.get("svc", {}).get("b") == "2":
+                break
+            time.sleep(0.25)
+        else:
+            raise AssertionError(
+                f"restarted process did not catch up: {workers['P2'].db()}"
+            )
+
+        # and it serves new traffic
+        workers["P2"].send(f"propose svc {b'PUT c 3'.hex()}")
+        assert workers["P2"].expect("resp ", 90).endswith(b"OK".hex())
+    finally:
+        for w in workers.values():
+            w.close()

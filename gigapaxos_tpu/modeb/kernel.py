@@ -13,25 +13,34 @@ The node step reuses the verified fused dataflow (``paxos_tick_impl``) and
 then keeps only row r of the result — peer rows stay whatever the last
 frames said.
 
-Why this is safe with stale mirrors: every cross-replica read in the fused
-tick consumes *monotone facts* —
+Why this is safe with stale mirrors: the tick runs with ``own_row=r``
+(``ops/tick.py``), which confines every state *transition* — candidacy,
+promise upgrade, prepare win, intake, accept — to row r.  Peer rows are
+pure frame-derived snapshots, and every cross-replica read then consumes
+only *monotone facts*:
 
 * a promise in a mirror row means that acceptor really promised that ballot
   at its frame snapshot (promises only rise), so counting a prepare
   majority from mirrors counts real promises, and the carryover window
-  rides the same snapshot (= "accepteds as of the promise", the classic
-  prepare-reply content, PaxosInstanceStateMachine.java:1017);
+  rides the same frame snapshot (= "accepteds as of the promise", the
+  classic prepare-reply content, PaxosInstanceStateMachine.java:1017);
 * a vote (accepted pvalue) in a mirror is a historical fact: once a
   majority ever accepted (slot, ballot, value), that value is chosen —
   tallying stale votes can only *under*-count, never fabricate a quorum;
+* a pushing peer coordinator (mirror coord_active + prop ring, shipped
+  together in one frame) is a real ACCEPT in flight — the value and ballot
+  are the peer's own consistent facts, never locally recomputed;
 * decisions are facts by construction.
 
+Without the own-row confinement the fused tick SIMULATES peer promises and
+accepts in the same step (that is Mode A's whole point: one device program
+IS the replica set), and counting those toward quorums would let an
+isolated minority self-elect and commit — split brain.  Regression:
+``tests/test_modeb_partition.py`` (isolated node must never commit; two
+live coordinators across a partition must not diverge).
+
 Staleness therefore costs latency (a decision needs a frame round-trip to
-gather votes), never agreement.  The one hazard is the intake phase: the
-fused tick may assign a request to a *peer* coordinator's proposal ring,
-which this wrapper then discards — the host must treat intake as accepted
-only when ``out.coord_id[row] == r`` and otherwise re-queue/forward
-(``manager.py``).
+gather votes), never agreement.
 """
 
 from __future__ import annotations
@@ -61,7 +70,7 @@ def node_tick_impl(state, inbox: TickInbox, r: int):
     the batching analog of PaxosPacketBatcher coalescing per-peer traffic,
     gigapaxos/PaxosPacketBatcher.java:28-35).
     """
-    new, out = paxos_tick_impl(state, inbox)
+    new, out = paxos_tick_impl(state, inbox, own_row=r)
     R = state.exec_slot.shape[0]
     row2 = (jnp.arange(R) == r)[:, None]        # [R, 1]
     row3 = row2[:, None, :]                      # [R, 1, 1]

@@ -117,6 +117,10 @@ class ModeBNode:
             collections.OrderedDict()
         )
         self._payload_cap = 1 << 16
+        #: rids ever queued from a forward (retransmit dedup, bounded)
+        self._routed: "collections.OrderedDict[int, bool]" = (
+            collections.OrderedDict()
+        )
         self._queues: Dict[int, collections.deque] = collections.defaultdict(
             collections.deque
         )
@@ -274,9 +278,21 @@ class ModeBNode:
             if row is None:
                 self._whois(gid, sender)
                 return
-            if rid in self.payloads or rid in self.outstanding:
-                return  # duplicate forward
+            if rid in self.outstanding:
+                return  # our own request; already routed locally
+            # NOTE: "payload already known" must NOT suppress queueing — the
+            # payload may have arrived via frame dissemination while the
+            # explicit forward is the only thing that makes us PROPOSE it
+            # (round-2 bug: dedup on payloads dropped forwarded requests).
+            # Retransmission dedup instead rides _routed: every rid we ever
+            # queued for proposal, GC'd at the same depth as the payload
+            # table (GCConcurrentHashMap of outstanding, PaxosManager.java:189).
             self._store_payload(rid, payload, stop)
+            if rid in self._routed:
+                return  # duplicate/late forward of a rid we already proposed
+            self._routed[rid] = True
+            while len(self._routed) > self._payload_cap:
+                self._routed.popitem(last=False)
             if rid not in self._queues[row]:
                 self._queues[row].append(rid)
 

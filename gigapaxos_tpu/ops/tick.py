@@ -103,9 +103,24 @@ def _lexmax(n, c, axis):
     return jnp.squeeze(nmax, axis=axis), cmax
 
 
-def paxos_tick_impl(state, inbox: TickInbox):
+def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1):
     """Un-jitted tick body (jit/shard it yourself; `paxos_tick` below is the
-    ready-made single-program jit with state donation)."""
+    ready-made single-program jit with state donation).
+
+    own_row: -1 for Mode A (all rows authoritative: the whole replica set is
+    one device program, so same-tick cross-row writes ARE the messages).
+    In Mode B (independent per-process nodes, ``modeb/``) peer rows are
+    frame-derived mirrors, and every state *transition* must be confined to
+    ``own_row``: a same-tick simulated peer promise/accept/candidacy/win is
+    not a fact — counting it toward an election or quorum lets an isolated
+    minority fabricate majorities (split brain), and a locally-"won" peer
+    candidacy would push that peer's stale mirror proposals under a fresh
+    ballot (conflicting values under one ballot).  With ``own_row >= 0`` the
+    masks below restrict start_prep / promise-upgrade / prepare-win / intake
+    / accept to the own row, so winning a prepare or deciding a slot
+    requires real promises/votes carried by received frames — mirroring the
+    reference, where a minority partition can never decide
+    (PaxosCoordinatorState majority tally, WaitforUtility)."""
     R, G = state.exec_slot.shape
     W = state.acc_req.shape[1]
     P = inbox.req.shape[1]
@@ -114,6 +129,8 @@ def paxos_tick_impl(state, inbox: TickInbox):
 
     alive = inbox.alive
     r_idx = jnp.arange(R, dtype=I32)[:, None]  # [R, 1] broadcasts over G
+    # Mode-B authority mask: transitions allowed only on the own row.
+    own2 = (r_idx == own_row) if own_row >= 0 else jnp.ones((R, 1), jnp.bool_)
     member = state.member  # [R, G] bool
     is_active = state.status == int(GroupStatus.ACTIVE)  # [R, G]
     acc_ok = member & alive[:, None] & is_active  # live active member [R, G]
@@ -158,7 +175,7 @@ def paxos_tick_impl(state, inbox: TickInbox):
     have_auth = (state.coord_active | state.coord_preparing) & bal_ge(
         state.coord_bnum, r_idx, state.bal_num, state.bal_coord
     )
-    start_prep = im_cand & coord_dead & ~have_auth
+    start_prep = im_cand & coord_dead & ~have_auth & own2
     coord_bnum = jnp.where(
         start_prep,
         jnp.maximum(state.bal_num, state.coord_bnum) + 1,
@@ -173,6 +190,7 @@ def paxos_tick_impl(state, inbox: TickInbox):
     best_pn, best_pc = _lexmax(pn, jnp.broadcast_to(r_idx, (R, G)), axis=0)  # [G]
     upgrade = (
         acc_ok
+        & own2
         & (best_pn[None, :] != NEG_INF)
         & bal_gt(best_pn[None, :], best_pc[None, :], state.bal_num, state.bal_coord)
     )
@@ -187,7 +205,7 @@ def paxos_tick_impl(state, inbox: TickInbox):
         & (bal_coord[None, :, :] == r_idx[:, None])
     )
     promises = jnp.sum(match, axis=1).astype(I32)  # [R, G]
-    won = prep_mask & (promises >= maj[None, :])  # at most one winner per g
+    won = prep_mask & (promises >= maj[None, :]) & own2  # ≤1 winner per g
 
     # Gather every replica's accepted window at the common base ring indices:
     # A_x[r, j, g] = acc_x[r, i_j[j, g], g].
@@ -244,7 +262,7 @@ def paxos_tick_impl(state, inbox: TickInbox):
     an = jnp.where(coord_active & acc_ok, coord_bnum, NEG_INF)
     w_n, w_c = _lexmax(an, jnp.broadcast_to(r_idx, (R, G)), axis=0)  # [G]
     has_coord = w_n != NEG_INF
-    is_win = (r_idx == w_c[None, :]) & has_coord[None, :]  # [R, G]
+    is_win = (r_idx == w_c[None, :]) & has_coord[None, :] & own2  # [R, G]
 
     req_flat = inbox.req.reshape(RP, G)
     stop_flat = inbox.stop.reshape(RP, G)
@@ -307,6 +325,7 @@ def paxos_tick_impl(state, inbox: TickInbox):
         & in_win
         & bal_ge(b_n[None], b_c[None], bal_num[:, None, :], bal_coord[:, None, :])
         & acc_ok[:, None, :]
+        & own2[:, None, :]
     )
     # ring plane for pvalue at slot p_slot is its own plane position already
     # (coordinators store proposals ring-indexed by slot), so accept in place.
@@ -455,7 +474,7 @@ def paxos_tick_impl(state, inbox: TickInbox):
     return new_state, outbox
 
 
-paxos_tick = jax.jit(paxos_tick_impl, donate_argnums=(0,))
+paxos_tick = jax.jit(paxos_tick_impl, donate_argnums=(0,), static_argnums=(2,))
 
 
 def make_inbox(n_replicas: int, n_groups: int, per_tick: int) -> TickInbox:

@@ -1,0 +1,162 @@
+"""Deterministic in-process network simulator: partitions + link delays.
+
+The reference tests liveness/failover at loopback RTT and emulates WAN
+latency by delaying JSON sends inside the transport
+(``nio/JSONDelayEmulator.java:39-77``, enabled by
+``TESTPaxosConfig``); partitions are emulated by crashing nodes
+(``TESTPaxosConfig.crash``).  This module gives the TPU framework both
+knobs with *deterministic* delivery: messages move only when the harness
+calls :meth:`SimNet.pump`, so a test can interleave ticks and delivery
+rounds exactly, hold a frame in flight across a coordinator change, or cut
+any directed link mid-protocol.
+
+:class:`SimMessenger` exposes the same surface as ``net.messenger.Messenger``
+(``demux``/``register``/``send``/``multicast``/``send_bytes``/``close``), so
+anything that speaks Messenger — ``ModeBNode``, protocol executors, the
+failure detector — runs unmodified over the simulator.
+"""
+
+from __future__ import annotations
+
+import collections
+import heapq
+import json
+from typing import Dict, Iterable, Optional, Tuple
+
+from ..net.transport import KIND_BYTES, KIND_JSON, JsonDemux
+
+
+class SimMessenger:
+    """One simulated node endpoint (Messenger-compatible)."""
+
+    def __init__(self, net: "SimNet", node_id: str):
+        self.net = net
+        self.node_id = node_id
+        self.demux = JsonDemux()
+        self.closed = False
+        self.port = 0  # no socket; Messenger-surface compatibility
+
+    def register(self, ptype, handler) -> None:
+        self.demux.register(ptype, handler)
+
+    def send(self, dest: str, packet: dict) -> None:
+        packet.setdefault("sender", self.node_id)
+        self.net._enqueue(self.node_id, dest, KIND_JSON,
+                          json.dumps(packet).encode())
+
+    def multicast(self, dests: Iterable[str], packet: dict) -> None:
+        packet.setdefault("sender", self.node_id)
+        for d in dests:
+            if d is not None:
+                self.net._enqueue(self.node_id, d, KIND_JSON,
+                                  json.dumps(packet).encode())
+
+    def send_bytes(self, dest: str, payload: bytes) -> None:
+        self.net._enqueue(self.node_id, dest, KIND_BYTES, payload)
+
+    def close(self) -> None:
+        self.closed = True
+
+
+class SimNet:
+    """The wire: directed links with up/down state and per-link delay.
+
+    Delay unit is *pump rounds* (a message sent at round t with link delay d
+    is delivered during the pump that advances past round t+d).  Default
+    delay 0 = delivered by the next ``pump()``.
+    """
+
+    def __init__(self):
+        self.endpoints: Dict[str, SimMessenger] = {}
+        self.round = 0
+        self._seq = 0
+        self._heap: list = []  # (due_round, seq, src, dst, kind, payload)
+        self._down: set = set()  # directed (src, dst)
+        self._delay: Dict[Tuple[str, str], int] = {}
+        self.default_delay = 0
+        self.stats = collections.Counter()
+
+    # ------------------------------------------------------------- topology
+    def messenger(self, node_id: str) -> SimMessenger:
+        m = SimMessenger(self, node_id)
+        self.endpoints[node_id] = m
+        return m
+
+    def set_delay(self, src: str, dst: str, rounds: int,
+                  both_ways: bool = True) -> None:
+        self._delay[(src, dst)] = rounds
+        if both_ways:
+            self._delay[(dst, src)] = rounds
+
+    def set_link(self, src: str, dst: str, up: bool,
+                 both_ways: bool = True) -> None:
+        pairs = [(src, dst)] + ([(dst, src)] if both_ways else [])
+        for p in pairs:
+            if up:
+                self._down.discard(p)
+            else:
+                self._down.add(p)
+
+    def partition(self, *sides: Iterable[str]) -> None:
+        """Cut every link between nodes of different sides (both ways)."""
+        groups = [set(s) for s in sides]
+        for i, a in enumerate(groups):
+            for b in groups[i + 1:]:
+                for x in a:
+                    for y in b:
+                        self._down.add((x, y))
+                        self._down.add((y, x))
+
+    def heal(self) -> None:
+        self._down.clear()
+
+    def drop_pending(self, src: Optional[str] = None,
+                     dst: Optional[str] = None) -> int:
+        """Discard in-flight messages (long-outage emulation: the real
+        transport's retries exhausted).  Returns how many were dropped."""
+        keep, dropped = [], 0
+        for item in self._heap:
+            if ((src is None or item[2] == src)
+                    and (dst is None or item[3] == dst)):
+                dropped += 1
+            else:
+                keep.append(item)
+        heapq.heapify(keep)
+        self._heap = keep
+        self.stats["dropped_pending"] += dropped
+        return dropped
+
+    # ------------------------------------------------------------- transfer
+    def _enqueue(self, src: str, dst: str, kind: int, payload: bytes) -> None:
+        if (src, dst) in self._down:
+            self.stats["dropped_down"] += 1
+            return
+        d = self._delay.get((src, dst), self.default_delay)
+        self._seq += 1
+        heapq.heappush(self._heap,
+                       (self.round + d, self._seq, src, dst, kind, payload))
+        self.stats["sent"] += 1
+
+    def pump(self, rounds: int = 1) -> int:
+        """Advance time and deliver everything due.  Returns deliveries."""
+        n = 0
+        for _ in range(rounds):
+            self.round += 1
+            while self._heap and self._heap[0][0] < self.round:
+                _, _, src, dst, kind, payload = heapq.heappop(self._heap)
+                ep = self.endpoints.get(dst)
+                if ep is None or ep.closed:
+                    self.stats["dropped_dead"] += 1
+                    continue
+                # a link cut while the message was in flight loses it, like
+                # a TCP connection reset mid-outage
+                if (src, dst) in self._down:
+                    self.stats["dropped_down"] += 1
+                    continue
+                try:
+                    ep.demux(src, kind, payload)
+                except Exception:
+                    self.stats["demux_errors"] += 1
+                n += 1
+                self.stats["delivered"] += 1
+        return n

@@ -1,0 +1,187 @@
+"""Mode B safety under partition: a minority must never decide.
+
+Regression for the round-2 split-brain: the fused tick simulates peer
+promises/accepts in the same step, and counting those toward elections or
+quorums let an isolated node self-elect and commit within 2 ticks.  The fix
+confines state transitions to the own row (``ops/tick.py`` own_row mask);
+these tests drive the exact adversarial schedules over the deterministic
+``SimNet`` — no sockets, no sleeps, exact interleavings.
+
+Reference behavior being matched: a minority partition can never form a
+majority (WaitforUtility / PaxosCoordinatorState tally), and healing
+converges every replica onto the single decided sequence.
+"""
+
+import pytest
+
+from gigapaxos_tpu.config import GigapaxosTpuConfig
+from gigapaxos_tpu.models.replicable import KVApp
+from gigapaxos_tpu.modeb import ModeBNode
+from gigapaxos_tpu.testing.simnet import SimNet
+
+IDS = ["N0", "N1", "N2"]
+
+
+class RecKV(KVApp):
+    """KVApp that records the executed payload sequence (for divergence
+    asserts: replicas must execute the same totally ordered sequence)."""
+
+    def __init__(self):
+        super().__init__()
+        self.trace = []
+
+    def execute(self, name, request, request_id):
+        self.trace.append((name, bytes(request)))
+        return super().execute(name, request, request_id)
+
+
+def make_cfg(groups=16, window=8):
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = groups
+    cfg.paxos.window = window
+    return cfg
+
+
+class SimCluster:
+    def __init__(self, n=3):
+        self.net = SimNet()
+        cfg = make_cfg()
+        self.apps = {nid: RecKV() for nid in IDS[:n]}
+        self.nodes = {
+            nid: ModeBNode(cfg, IDS[:n], nid, self.apps[nid],
+                           self.net.messenger(nid), anti_entropy_every=8)
+            for nid in IDS[:n]
+        }
+
+    def create(self, name):
+        for nd in self.nodes.values():
+            nd.create_group(name, list(range(len(self.nodes))))
+
+    def spin(self, k, only=None):
+        for _ in range(k):
+            for nid, nd in self.nodes.items():
+                if only is None or nid in only:
+                    nd.tick()
+            self.net.pump()
+
+    def commit(self, at, name, payload, max_ticks=200, only=None):
+        done = []
+        rid = self.nodes[at].propose(name, payload,
+                                     lambda _r, resp: done.append(resp))
+        assert rid is not None
+        for _ in range(max_ticks):
+            self.spin(1, only=only)
+            if done:
+                return done[0]
+        raise AssertionError(f"no commit of {payload!r} at {at}")
+
+
+@pytest.fixture()
+def cluster():
+    return SimCluster()
+
+
+def test_isolated_node_never_commits():
+    """The advisor's empirical repro: an isolated 3-member node (zero frames
+    ever received) must not self-elect a majority and must execute nothing."""
+    net = SimNet()
+    app = RecKV()
+    node = ModeBNode(make_cfg(), IDS, "N0", app, net.messenger("N0"))
+    node.create_group("svc", [0, 1, 2])
+    done = []
+    rid = node.propose("svc", b"PUT x 1", lambda _r, resp: done.append(resp))
+    assert rid is not None
+    for _ in range(60):
+        node.tick()
+        net.pump()
+    assert not done, "isolated minority committed (split brain)"
+    assert node.stats["executions"] == 0
+    assert app.db.get("svc", {}) == {}
+
+
+def test_partition_two_coordinators_no_divergence(cluster):
+    """Stale mirrors + two live coordinators: the deposed coordinator (N0,
+    isolated, still believing it leads with mirrors showing its old ballot)
+    must not commit; the majority side elects N1 and commits; healing
+    converges all three onto one sequence, including N0's delayed request."""
+    cluster.create("svc")
+    assert cluster.commit("N0", "svc", b"PUT a 1") == b"OK"
+    cluster.spin(10)  # let the decision reach everyone
+    row = cluster.nodes["N0"].rows.row("svc")
+    assert int(cluster.nodes["N0"]._coord_view[row]) == 0  # N0 leads
+
+    # -- partition: {N0} | {N1, N2}; majority's FD view marks N0 dead,
+    #    N0's own view stays stale (it still sees everyone alive)
+    cluster.net.partition({"N0"}, {"N1", "N2"})
+    for nid in ("N1", "N2"):
+        cluster.nodes[nid].set_alive(0, False)
+
+    solo_done, maj_done = [], []
+    cluster.nodes["N0"].propose("svc", b"PUT solo S",
+                                lambda _r, x: solo_done.append(x))
+    cluster.nodes["N1"].propose("svc", b"PUT maj M",
+                                lambda _r, x: maj_done.append(x))
+    cluster.spin(120)
+
+    # majority decided; minority did not (and executed nothing new)
+    assert maj_done and maj_done[0] == b"OK"
+    for nid in ("N1", "N2"):
+        assert cluster.apps[nid].db["svc"]["maj"] == "M", nid
+    assert not solo_done, "isolated minority committed (split brain)"
+    assert "solo" not in cluster.apps["N0"].db.get("svc", {})
+    assert "maj" not in cluster.apps["N0"].db.get("svc", {})
+    n0_trace_at_partition = list(cluster.apps["N0"].trace)
+
+    # -- heal: N0 rejoins, must adopt the majority's sequence and its own
+    #    delayed request must commit after (no lost update, no divergence)
+    cluster.net.heal()
+    for nid in ("N1", "N2"):
+        cluster.nodes[nid].set_alive(0, True)
+    for _ in range(400):
+        cluster.spin(1)
+        if solo_done and all(
+            cluster.apps[nid].db.get("svc", {}).get("solo") == "S"
+            for nid in IDS
+        ):
+            break
+    assert solo_done and solo_done[0] == b"OK"
+    want = {"a": "1", "maj": "M", "solo": "S"}
+    for nid in IDS:
+        assert cluster.apps[nid].db["svc"] == want, nid
+
+    # divergence check: the two majority replicas executed the same totally
+    # ordered sequence; N0 executed a consistent subsequence (it may have
+    # repaired by checkpoint transfer, which skips — never reorders)
+    t1 = [p for (_n, p) in cluster.apps["N1"].trace]
+    t2 = [p for (_n, p) in cluster.apps["N2"].trace]
+    assert t1 == t2
+    t0 = [p for (_n, p) in cluster.apps["N0"].trace]
+    it = iter(t1)
+    assert all(any(p == q for q in it) for p in t0), (t0, t1)
+    # and N0 executed nothing while partitioned
+    assert [p for (_n, p) in n0_trace_at_partition] == [b"PUT a 1"]
+
+
+def test_in_flight_frames_across_coordinator_change(cluster):
+    """Frames delayed across a coordinator change must not resurrect the old
+    coordinator's authority: deliveries carry facts (ballots/votes), and old
+    ballots lose the lexmax, so late frames are harmless."""
+    cluster.create("svc")
+    assert cluster.commit("N0", "svc", b"PUT k 0") == b"OK"
+    cluster.spin(5)
+    # slow N0's outbound links: its frames now arrive 6 rounds late
+    cluster.net.set_delay("N0", "N1", 6, both_ways=False)
+    cluster.net.set_delay("N0", "N2", 6, both_ways=False)
+    # majority deposes N0 while N0 keeps ticking and framing (stale ballot)
+    for nid in ("N1", "N2"):
+        cluster.nodes[nid].set_alive(0, False)
+    assert cluster.commit("N1", "svc", b"PUT k 1", only=("N1", "N2")) == b"OK"
+    # now let N0's delayed stale frames drain into the new regime
+    for nid in ("N1", "N2"):
+        cluster.nodes[nid].set_alive(0, True)
+    cluster.spin(40)
+    for nid in IDS:
+        assert cluster.apps[nid].db["svc"]["k"] == "1", nid
+    t1 = [p for (_n, p) in cluster.apps["N1"].trace]
+    t2 = [p for (_n, p) in cluster.apps["N2"].trace]
+    assert t1 == t2

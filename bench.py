@@ -9,21 +9,43 @@ Load generation runs on-device (the analog of the in-JVM TESTPaxosClient) so
 the measurement is the consensus engine, not host Python.  Prints ONE JSON
 line: {"metric", "value", "unit", "vs_baseline"}.
 
+Failure behavior (round-2 fix): if the TPU backend fails to initialize, the
+run is NOT silent — a fresh subprocess re-runs the bench on the CPU backend
+at a reduced size, and the single output line carries both the CPU sanity
+number and a structured ``diagnostic`` of the TPU failure, so a red driver
+run still records information.
+
 Env knobs: GPTPU_BENCH_GROUPS (default 1<<20), GPTPU_BENCH_TICKS (default 30),
-GPTPU_BENCH_REPLICAS (3), GPTPU_BENCH_WINDOW (8).
+GPTPU_BENCH_REPLICAS (3), GPTPU_BENCH_WINDOW (8), GPTPU_BENCH_PLATFORM
+(force a jax platform, e.g. "cpu"; also disables the fallback recursion),
+GPTPU_BENCH_APP=device_kv (fuse the device-resident KV app behind the tick —
+decisions execute on-device, models/device_kv.py).
 """
 
 import json
 import os
+import subprocess
+import sys
 import time
+
 
 import numpy as np
 
 BASELINE_DECISIONS_PER_SEC = 100_000.0  # north star: >=100k dec/s/chip
 
+FALLBACK_GROUPS = 1 << 16
+FALLBACK_TICKS = 10
 
-def main():
+
+def run_bench() -> dict:
     import jax
+
+    platform = os.environ.get("GPTPU_BENCH_PLATFORM")
+    if platform:
+        # sitecustomize forces jax_platforms="axon,cpu"; env alone cannot
+        # override it, so set the config directly before any jax op runs
+        jax.config.update("jax_platforms", platform)
+
     import jax.numpy as jnp
 
     from gigapaxos_tpu.ops.tick import TickInbox, paxos_tick_impl
@@ -40,7 +62,9 @@ def main():
         state, np.arange(G, dtype=np.int32), np.ones((G, R), bool)
     )
 
-    def step(state, rid_base):
+    device_app = os.environ.get("GPTPU_BENCH_APP") == "device_kv"
+
+    def make_inbox(rid_base):
         # on-device load generator: every group gets one fresh request id per
         # tick at entry replica (g % R)
         g = jnp.arange(G, dtype=jnp.int32)
@@ -49,41 +73,147 @@ def main():
         req = req.at[:, 0, :].set(
             jnp.where(g[None, :] % R == jnp.arange(R)[:, None], rids[None, :], 0)
         )
-        inbox = TickInbox(
+        return TickInbox(
             req, jnp.zeros((R, P, G), jnp.bool_), jnp.ones((R,), jnp.bool_)
-        )
-        new_state, out = paxos_tick_impl(state, inbox)
-        return new_state, jnp.sum(out.decided_now)
+        ), rids
 
-    def step_acc(state, acc, rid_base):
-        # decisions accumulate on device; the host reads one scalar at the end
-        state, d = step(state, rid_base)
-        return state, acc + d
+    if device_app:
+        from gigapaxos_tpu.models.device_kv import (OP_PUT, fused_step,
+                                                    init_kv,
+                                                    register_requests)
 
-    step_j = jax.jit(step_acc, donate_argnums=(0, 1))
+        slots = 8
+        table = 1 << max(16, (4 * G - 1).bit_length())
+        kv = init_kv(R, G, slots=slots, table=table)
 
-    # warmup/compile
-    state, acc = step_j(state, jnp.int32(0), jnp.int32(1))
-    jax.block_until_ready(acc)
-    acc = jnp.int32(0)
+        def step_acc(state, kv, acc, rid_base):
+            inbox, rids = make_inbox(rid_base)
+            g = jnp.arange(G, dtype=jnp.int32)
+            # synthetic KV workload (the TESTPaxosApp state-update analog):
+            # PUT key (g & slots-1) = rid, descriptors registered on-device
+            kv = register_requests(
+                kv, rids, jnp.full(G, OP_PUT, jnp.int32),
+                jnp.bitwise_and(g, slots - 1) + 1, rids,
+            )
+            state, kv, out, _resp, _miss = fused_step(state, kv, inbox)
+            return state, kv, acc + jnp.sum(out.decided_now)
 
-    t0 = time.perf_counter()
-    for i in range(n_ticks):
-        state, acc = step_j(state, acc, jnp.int32(1 + (i + 1) * G))
-    total_decisions = int(acc)  # blocks until all ticks complete
-    dt = time.perf_counter() - t0
+        step_j = jax.jit(step_acc, donate_argnums=(0, 1, 2))
+        state, kv, acc = step_j(state, kv, jnp.int32(0), jnp.int32(1))
+        jax.block_until_ready(acc)
+        acc = jnp.int32(0)
+        t0 = time.perf_counter()
+        for i in range(n_ticks):
+            state, kv, acc = step_j(state, kv, acc, jnp.int32(1 + (i + 1) * G))
+        total_decisions = int(acc)
+        dt = time.perf_counter() - t0
+    else:
+        def step_acc(state, acc, rid_base):
+            inbox, _rids = make_inbox(rid_base)
+            new_state, out = paxos_tick_impl(state, inbox)
+            return new_state, acc + jnp.sum(out.decided_now)
+
+        step_j = jax.jit(step_acc, donate_argnums=(0, 1))
+        state, acc = step_j(state, jnp.int32(0), jnp.int32(1))
+        jax.block_until_ready(acc)
+        acc = jnp.int32(0)
+        t0 = time.perf_counter()
+        for i in range(n_ticks):
+            state, acc = step_j(state, acc, jnp.int32(1 + (i + 1) * G))
+        total_decisions = int(acc)  # blocks until all ticks complete
+        dt = time.perf_counter() - t0
 
     dps = total_decisions / dt
-    print(
-        json.dumps(
-            {
-                "metric": f"decisions_per_sec_per_chip_{G}_groups_{R}_replicas",
-                "value": round(dps, 1),
-                "unit": "decisions/s",
-                "vs_baseline": round(dps / BASELINE_DECISIONS_PER_SEC, 2),
-            }
-        )
+    backend = jax.devices()[0].platform
+    suffix = f"_{backend}" if backend not in ("tpu", "axon") else ""
+    app_tag = "_device_kv" if device_app else ""
+    return {
+        "metric": (f"decisions_per_sec_per_chip_{G}_groups_{R}_replicas"
+                   f"{app_tag}{suffix}"),
+        "value": round(dps, 1),
+        "unit": "decisions/s",
+        "vs_baseline": round(dps / BASELINE_DECISIONS_PER_SEC, 2),
+    }
+
+
+def _cpu_fallback(diag: dict) -> dict:
+    """Fresh subprocess on the CPU backend at reduced size: a poisoned
+    in-process backend registry cannot be reset, so re-exec is the only
+    reliable path to a sanity number after a TPU init failure."""
+    env = dict(os.environ)
+    env["GPTPU_BENCH_PLATFORM"] = "cpu"
+    env.setdefault("GPTPU_BENCH_GROUPS", str(FALLBACK_GROUPS))
+    env["GPTPU_BENCH_GROUPS"] = str(
+        min(int(env["GPTPU_BENCH_GROUPS"]), FALLBACK_GROUPS)
     )
+    env["GPTPU_BENCH_TICKS"] = str(FALLBACK_TICKS)
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=900, env=env,
+        )
+        for line in reversed(out.stdout.strip().splitlines()):
+            try:
+                result = json.loads(line)
+                break
+            except ValueError:
+                continue
+        else:
+            raise ValueError(f"no JSON line in fallback output: {out.stdout[-300:]!r}")
+    except Exception as e:  # even the fallback failed: still emit structure
+        result = {
+            "metric": "decisions_per_sec_per_chip_fallback_failed",
+            "value": 0.0,
+            "unit": "decisions/s",
+            "vs_baseline": 0.0,
+            "fallback_error": f"{type(e).__name__}: {e}"[:300],
+        }
+    result["diagnostic"] = diag
+    return result
+
+
+def main():
+    if os.environ.get("GPTPU_BENCH_PLATFORM") or os.environ.get(
+        "GPTPU_BENCH_INNER"
+    ):
+        # inner/forced-platform run: do the work directly, fail loudly
+        print(json.dumps(run_bench()))
+        return
+    # Orchestrator: attempt the ambient (TPU) backend in a subprocess under
+    # a watchdog — a broken tunnel can hang backend init for ~40 minutes,
+    # which must not silently eat the whole bench budget.
+    tpu_timeout = float(os.environ.get("GPTPU_BENCH_TPU_TIMEOUT_S", 1500))
+    diag = None
+    try:
+        env = dict(os.environ)
+        env["GPTPU_BENCH_INNER"] = "1"
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=tpu_timeout, env=env,
+        )
+        if out.returncode == 0:
+            for line in reversed(out.stdout.strip().splitlines()):
+                try:
+                    print(json.dumps(json.loads(line)))
+                    return
+                except ValueError:
+                    continue
+        diag = {
+            "error": f"bench subprocess rc={out.returncode}",
+            "message": (out.stderr.strip().splitlines() or ["no stderr"])[-1][:500],
+            "note": "TPU backend init/run failed; value below is the CPU "
+                    "fallback sanity number, NOT a TPU datum",
+        }
+    except subprocess.TimeoutExpired:
+        diag = {
+            "error": "timeout",
+            "message": f"TPU bench exceeded {tpu_timeout:.0f}s watchdog "
+                       "(hung backend init or pathologically slow tunnel)",
+            "note": "value below is the CPU fallback sanity number, NOT a "
+                    "TPU datum",
+        }
+    result = _cpu_fallback(diag)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -45,10 +45,16 @@ class ReconfigurableAppClient:
         bind_host: str = "127.0.0.1",
         actives_ttl_s: float = 30.0,
         explore_prob: float = 0.1,
+        security=None,
     ):
+        """``security``: a ``TransportSecurity`` for TLS deployments — under
+        MUTUAL_AUTH it must carry a CA-signed client certificate (the
+        reference's mutual-auth client types,
+        ReconfigurableAppClientAsync.java:35)."""
         self.node_id = client_id or f"C{uuid.uuid4().hex[:8]}"
         self.nodemap = NodeMap(nodes)
-        self.m = Messenger(self.node_id, (bind_host, 0), self.nodemap)
+        self.m = Messenger(self.node_id, (bind_host, 0), self.nodemap,
+                           security=security)
         self.addr = (bind_host, self.m.port)
         self.rc_ids = list(nodes.reconfigurator_ids())
         if not self.rc_ids:
@@ -72,7 +78,8 @@ class ReconfigurableAppClient:
         self._actives: Dict[str, Tuple[float, List[str]]] = {}
         self._rtt: Dict[str, float] = {}  # active id -> EWMA seconds
         self._sent_at: Dict[int, Tuple[str, float]] = {}
-        for t in (pkt.CREATE_RESPONSE, pkt.DELETE_RESPONSE,
+        for t in (pkt.CREATE_RESPONSE, pkt.CREATE_BATCH_RESPONSE,
+                  pkt.DELETE_RESPONSE,
                   pkt.ACTIVES_RESPONSE, pkt.RECONFIGURE_RESPONSE,
                   pkt.APP_RESPONSE, pkt.ECHO_REPLY,
                   pkt.NODE_CONFIG_RESPONSE):
@@ -150,6 +157,46 @@ class ReconfigurableAppClient:
             pkt.create_service_name(name, initial_state, 0), timeout
         )
 
+    def create_batch(self, items, timeout: float = 30.0) -> dict:
+        """Create many names with one RC commit per RC group
+        (BatchedCreateServiceName.java; the client partitions by the names'
+        RC groups like ReconfigurableAppClientAsync does).
+
+        items: iterable of ``name`` or ``(name, initial_state)``.
+        Returns {"ok": all_ok, "results": {name: {...}}}.
+        """
+        from .reconfiguration.consistent_hashing import ConsistentHashRing
+
+        creates = [
+            (it, b"") if isinstance(it, str) else (it[0], it[1])
+            for it in items
+        ]
+        ring = ConsistentHashRing(sorted(self.rc_ids))
+        parts: Dict[str, list] = {}
+        for n, s in creates:
+            parts.setdefault(ring.replicated_servers(n, 1)[0], []).append((n, s))
+        results: Dict[str, dict] = {}
+        rids = []
+        for primary, batch in parts.items():
+            p = pkt.create_batch(batch, self._rid())
+            rids.append((primary, p))
+            self.m.send(primary, self._stamp(p))
+        deadline = time.monotonic() + timeout
+        for primary, p in rids:
+            left = max(deadline - time.monotonic(), 0.5)
+            try:
+                resp = self._await(p["rid"], left)
+            except TimeoutError:
+                # one retry through a rotated RC (the commit is idempotent
+                # per name: duplicates come back as per-name "exists")
+                p2 = dict(p)
+                p2["rid"] = self._rid()
+                self.m.send(next(self._rc_rr), self._stamp(p2))
+                resp = self._await(p2["rid"], max(deadline - time.monotonic(), 0.5))
+            results.update(resp.get("results") or {})
+        return {"ok": all(r.get("ok") for r in results.values()) and bool(results),
+                "results": results}
+
     def delete(self, name: str, timeout: float = 15.0) -> dict:
         resp = self._rpc_rc(pkt.delete_service_name(name, 0), timeout)
         with self._lock:
@@ -178,6 +225,26 @@ class ReconfigurableAppClient:
         resp = self._rpc_rc({"type": pkt.REMOVE_ACTIVE, "node": node}, timeout)
         with self._lock:
             self._actives.clear()  # placements may be migrating
+        return resp
+
+    def add_reconfigurator(self, node: str, host: str, port: int,
+                           timeout: float = 15.0) -> dict:
+        """Admin: splice a reconfigurator into the RC pool at runtime
+        (ReconfigureRCNodeConfig analog, Reconfigurator.java:1044)."""
+        resp = self._rpc_rc({"type": pkt.ADD_RC, "node": node,
+                             "addr": [host, port]}, timeout)
+        if resp.get("ok"):
+            self.nodemap.add(node, host, port)
+            if node not in self.rc_ids:
+                self.rc_ids.append(node)
+                self._rc_rr = itertools.cycle(sorted(self.rc_ids))
+        return resp
+
+    def remove_reconfigurator(self, node: str, timeout: float = 15.0) -> dict:
+        resp = self._rpc_rc({"type": pkt.REMOVE_RC, "node": node}, timeout)
+        if resp.get("ok") and node in self.rc_ids:
+            self.rc_ids.remove(node)
+            self._rc_rr = itertools.cycle(sorted(self.rc_ids))
         return resp
 
     def request_actives(self, name: str, timeout: float = 10.0,
@@ -263,6 +330,7 @@ class ReconfigurableAppClient:
                     resp = self._await(rid, per)
                 except TimeoutError:
                     last = f"timeout via {target}"
+                    self._penalize(target, per)
                     continue
                 if resp.get("ok"):
                     return pkt.b64d(resp["response"]) or b""
@@ -275,6 +343,52 @@ class ReconfigurableAppClient:
             # a late response from an earlier attempt's target leaves the
             # newest _sent_at entry unconsumed (sender mismatch keeps it);
             # the sync path owns this rid end-to-end, so always reap it
+            with self._lock:
+                self._sent_at.pop(rid, None)
+
+    def _penalize(self, target: str, timeout_s: float) -> None:
+        """Feed a timeout into the target's EWMA as a huge latency sample —
+        without this, a dead replica keeps its excellent pre-crash RTT and
+        the lowest-RTT redirector keeps picking it forever (the reference's
+        redirector learns failed probes the same way,
+        E2ELatencyAwareRedirector.java:18)."""
+        with self._lock:
+            prev = self._rtt.get(target, 0.0)
+            self._rtt[target] = max(prev * 4, timeout_s)
+
+    def request_anycast(self, name: str, payload: bytes,
+                        timeout: float = 15.0, tries: int = 4) -> bytes:
+        """Send WITHOUT resolving the name's replica set: resolve the whole
+        active pool once (cached) and send to a random active; a non-hosting
+        active forwards to a hosting one, which answers us directly
+        (sendRequestAnycast, ReconfigurableAppClientAsync.java:1357)."""
+        per = max(timeout / tries, 0.5)
+        last = "timeout"
+        rid = self._rid()
+        try:
+            for attempt in range(tries):
+                pool = self.request_actives(pkt.ALL_ACTIVES,
+                                            force=attempt > 0)
+                target = random.choice(pool)
+                p = pkt.app_request(name, payload, rid)
+                p["anycast"] = True
+                with self._lock:
+                    self._sent_at[rid] = (target, time.monotonic())
+                self.m.send(target, self._stamp(p))
+                try:
+                    resp = self._await(rid, per)
+                except TimeoutError:
+                    last = f"timeout via {target}"
+                    self._penalize(target, per)
+                    continue
+                if resp.get("ok"):
+                    return pkt.b64d(resp["response"]) or b""
+                last = resp.get("error", "error")
+                if last not in ("not_active", "stopped"):
+                    raise ClientError(f"{name}: {last}")
+                time.sleep(min(0.1 * (attempt + 1), 0.5))
+            raise TimeoutError(f"{name}: {last}")
+        finally:
             with self._lock:
                 self._sent_at.pop(rid, None)
 

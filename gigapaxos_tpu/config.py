@@ -63,6 +63,26 @@ class FailureDetectionConfig:
 
 
 @dataclass
+class SSLConfig:
+    """Transport security (SSL stack analog,
+    nio/SSLDataProcessingWorker.java:59: CLEAR/SERVER_AUTH/MUTUAL_AUTH,
+    selected per deployment like ReconfigurableNode.java:298).
+
+    Properties keys: ``ssl.mode=mutual_auth``, ``ssl.certfile=...``,
+    ``ssl.keyfile=...``, ``ssl.cafile=...``.
+    """
+
+    mode: str = "clear"  # clear | server_auth | mutual_auth
+    certfile: str = ""
+    keyfile: str = ""
+    cafile: str = ""
+
+    def __post_init__(self) -> None:
+        if self.mode not in ("clear", "server_auth", "mutual_auth"):
+            raise ValueError(f"bad ssl.mode {self.mode!r}")
+
+
+@dataclass
 class NodeConfig:
     """Cluster topology: node id -> (host, port).
 
@@ -84,6 +104,7 @@ class NodeConfig:
 class GigapaxosTpuConfig:
     paxos: PaxosTuning = field(default_factory=PaxosTuning)
     fd: FailureDetectionConfig = field(default_factory=FailureDetectionConfig)
+    ssl: SSLConfig = field(default_factory=SSLConfig)
     nodes: NodeConfig = field(default_factory=NodeConfig)
     # WAL directory; None = in-memory only (tests).
     log_dir: str | None = None
@@ -148,7 +169,7 @@ def load_properties(path: str) -> GigapaxosTpuConfig:
 
 def apply_env_overrides(cfg: GigapaxosTpuConfig) -> None:
     """Apply ``GPTPU_<SECTION>_<FIELD>`` environment overrides and re-validate."""
-    for sub_name in ("paxos", "fd"):
+    for sub_name in ("paxos", "fd", "ssl"):
         sub = getattr(cfg, sub_name)
         for f_ in dataclasses.fields(sub):
             env = os.environ.get(f"GPTPU_{sub_name.upper()}_{f_.name.upper()}")
@@ -159,7 +180,7 @@ def apply_env_overrides(cfg: GigapaxosTpuConfig) -> None:
 
 def validate(cfg: GigapaxosTpuConfig) -> None:
     """Re-run dataclass validation (setattr bypasses ``__post_init__``)."""
-    for sub_name in ("paxos", "fd"):
+    for sub_name in ("paxos", "fd", "ssl"):
         sub = getattr(cfg, sub_name)
         post = getattr(sub, "__post_init__", None)
         if post is not None:

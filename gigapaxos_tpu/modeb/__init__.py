@@ -15,6 +15,8 @@ from .wire import decode_frame, encode_frame, gid_of
 __all__ = [
     "ModeBLogger",
     "ModeBNode",
+    "ModeBReplicaCoordinator",
+    "ModeBRepliconfigurableDB",
     "decode_frame",
     "encode_frame",
     "gid_of",
@@ -23,3 +25,8 @@ __all__ = [
     "recover_modeb",
     "rid_origin",
 ]
+
+from .coordinator import (  # noqa: E402  (needs manager first)
+    ModeBReplicaCoordinator,
+    ModeBRepliconfigurableDB,
+)

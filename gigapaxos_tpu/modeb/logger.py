@@ -200,11 +200,13 @@ def recover_modeb(cfg, member_ids, node_id, app, log_dir: str,
                 alive = np.frombuffer(alive_b, dtype=bool)
                 inbox = TickInbox(jnp.asarray(req), jnp.asarray(stp),
                                   jnp.asarray(alive))
+                node._flush_mirrors()  # frames staged since the last tick
                 node.state, out, changed = node._tick(node.state, inbox)
                 node._process_outbox(out)
                 node._dirty |= np.asarray(changed)
                 node.tick_num = tick_num + 1
 
+    node._flush_mirrors()  # frames journaled after the last tick record
     node._held_callbacks = []  # no live clients to answer during replay
     # close the rid-regression hole: every rid that could ever commit is
     # visible in some ring or payload/outstanding table — never hand out a

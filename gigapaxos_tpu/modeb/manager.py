@@ -40,12 +40,13 @@ import numpy as np
 from ..config import GigapaxosTpuConfig
 from ..models.replicable import Replicable
 from ..net.messenger import Messenger
+from ..net.transport import SendFailure
 from ..ops.tick import TickInbox
 from ..types import GroupStatus, NO_REQUEST
 from ..utils.intmap import RowAllocator
 from ..paxos import state as st
 from . import wire
-from .kernel import node_tick
+from .kernel import mirror_apply, node_tick
 
 #: request ids are node-scoped: high bits carry the origin replica slot so
 #: any node can route the response duty without a lookup (the entry-replica
@@ -139,11 +140,26 @@ class ModeBNode:
         self._force_full = True  # first frame announces full own row
         self._placed: list = []
         self._pending_whois: set = set()
+        #: decoded frames awaiting the once-per-tick fused mirror apply:
+        #: (sender_r, local_rows, frame_row_selector, Frame)
+        self._pending_mirror: list = []
         self._frame_applied_tick: Dict[int, int] = {}
         self._last_frame_rx = 0  # our tick count when a frame last arrived
         self.stats = collections.Counter()
         self.lock = threading.RLock()
         self._tick = node_tick(self.r)
+
+        self._fd = None
+        #: work-arrival hook (TickDriver.kick): lets the driver sleep long
+        #: while idle — essential when many nodes share few cores — yet
+        #: react to proposals/frames at interactive latency
+        self.on_work: Optional[Callable[[], None]] = None
+        #: whois-birth gate: self-healing creation of unknown groups
+        #: (missed-birthing, PaxosManager.java:2459-2469) is wrong for
+        #: control-plane-managed epoch groups — their birth must carry the
+        #: previous epoch's final state, which only StartEpoch delivers.
+        #: The control-plane binding installs a filter; None = allow all.
+        self.whois_birth: Optional[Callable[[str], bool]] = None
 
         self.wal = wal
         if wal is not None:
@@ -211,6 +227,18 @@ class ModeBNode:
             self._row_meta.pop(row, None)
             self._queues.pop(row, None)
             self._stopped_rows.discard(row)
+            # purge staged mirror frames targeting the freed row: their row
+            # indices were resolved at frame-arrival time, and a group
+            # recreated into the recycled row must not inherit stale facts
+            if self._pending_mirror:
+                pend = []
+                for sr, rows, keep, frame in self._pending_mirror:
+                    sel = rows != row
+                    if sel.all():
+                        pend.append((sr, rows, keep, frame))
+                    elif sel.any():
+                        pend.append((sr, rows[sel], keep[sel], frame))
+                self._pending_mirror = pend
             if _log and self.wal is not None:
                 self.wal.log_remove(name)
             return True
@@ -218,9 +246,47 @@ class ModeBNode:
     def set_alive(self, r: int, up: bool) -> None:
         self.alive[r] = up
 
+    def attach_failure_detector(self, fd) -> None:
+        """Feed the liveness mask from a keep-alive failure detector: every
+        tick re-derives ``alive`` from ``fd.alive_mask`` (own row always up).
+        This is the reference's FailureDetection → checkRunForCoordinator
+        wiring (gigapaxos/FailureDetection.java:209-258 feeding
+        PaxosInstanceStateMachine.java:2070) — candidacy in the tick kernel
+        consults exactly this mask.  Replaces any manual ``set_alive``
+        control (which remains only as a harness hook)."""
+        self._fd = fd
+        for nid in self.members:
+            fd.monitor(nid)
+
     def is_stopped(self, name: str) -> bool:
         row = self.rows.row(name)
         return row is not None and row in self._stopped_rows
+
+    def group_members(self, name: str):
+        """Replica-slot members of a group (``getReplicaGroup`` analog,
+        PaxosManager.java:561); None if unknown."""
+        with self.lock:
+            row = self.rows.row(name)
+            if row is None:
+                return None
+            meta = self._row_meta.get(row)
+            return list(meta[1]) if meta is not None else None
+
+    def group_epoch(self, name: str):
+        with self.lock:
+            row = self.rows.row(name)
+            if row is None:
+                return None
+            meta = self._row_meta.get(row)
+            return meta[2] if meta is not None else None
+
+    def is_tainted(self, name: str) -> bool:
+        """True when this node's app copy for ``name`` diverged (skipped a
+        payload-less decision) and awaits checkpoint repair — it must not be
+        trusted as a state donor."""
+        with self.lock:
+            row = self.rows.row(name)
+            return row is not None and row in self._tainted_rows
 
     # ---------------------------------------------------------------- propose
     def propose(self, name: str, payload: bytes,
@@ -232,13 +298,22 @@ class ModeBNode:
                 if callback is not None:
                     self._held_callbacks.append((callback, -1, None))
                 return None
+            if self._next_seq >= RID_MASK:
+                # 2^24 own-origin proposals: the sequence would bleed into
+                # the origin bits and corrupt rid routing — fail loudly
+                # instead of silently colliding (advisor round 2)
+                raise RuntimeError(
+                    f"{self.node_id}: rid sequence space exhausted "
+                    f"({self._next_seq} >= 2^{RID_SHIFT})"
+                )
             rid = (self.r << RID_SHIFT) | self._next_seq
             self._next_seq += 1
             rec = ModeBRecord(rid, name, row, payload, stop, callback,
                               self.tick_num)
             self.outstanding[rid] = rec
             self._route(rec)
-            return rid
+        self._wake()
+        return rid
 
     def propose_stop(self, name: str, payload: bytes = b"", callback=None):
         return self.propose(name, payload, callback, stop=True)
@@ -295,6 +370,11 @@ class ModeBNode:
                 self._routed.popitem(last=False)
             if rid not in self._queues[row]:
                 self._queues[row].append(rid)
+        self._wake()
+
+    def _wake(self) -> None:
+        if self.on_work is not None:
+            self.on_work()
 
     def _store_payload(self, rid: int, payload: bytes, stop: bool) -> None:
         self.payloads[rid] = (payload, stop)
@@ -320,6 +400,11 @@ class ModeBNode:
     # ------------------------------------------------------------------- tick
     def tick(self):
         with self.lock:
+            if self._fd is not None:
+                mask = self._fd.alive_mask(self.members)
+                mask[self.r] = True
+                self.alive = mask
+            self._flush_mirrors()
             inbox = self._build_inbox()
             if self.wal is not None:
                 self.wal.log_inbox(self.tick_num, inbox)
@@ -338,7 +423,12 @@ class ModeBNode:
         if frame is not None and self.m is not None:
             for i, peer in enumerate(self.members):
                 if i != self.r:
-                    self.m.send_bytes(peer, frame)
+                    try:
+                        self.m.send_bytes(peer, frame)
+                    except SendFailure:
+                        # transport closing underneath a final tick — the
+                        # anti-entropy full frame re-ships state anyway
+                        self.stats["send_failures"] += 1
         return out
 
     def _build_inbox(self) -> TickInbox:
@@ -356,10 +446,14 @@ class ModeBNode:
                     if rec is not None:
                         self._forward(rec, coord)
                     elif rid in self.payloads:
+                        name = self.rows.name(row)
+                        if name is None:
+                            continue  # group freed underneath: drop the rid
+                            # rather than forward under a bogus gid
                         payload, stop = self.payloads[rid]
                         self.m.send(self.members[coord], {
-                            "type": MB_PROPOSAL, "rid": rid, "gid":
-                            str(wire.gid_of(self.rows.name(row) or "")),
+                            "type": MB_PROPOSAL, "rid": rid,
+                            "gid": str(wire.gid_of(name)),
                             "payload": payload.hex(), "stop": stop,
                         })
                 continue
@@ -528,8 +622,10 @@ class ModeBNode:
         }
         self.stats["frames_sent"] += 1
         self.stats["frame_groups"] += len(rows_idx)
-        return wire.encode_frame(r, self.tick_num, self.W, gids, scalars,
-                                 flags, rings, ring_bits, pay, full=full)
+        buf = wire.encode_frame(r, self.tick_num, self.W, gids, scalars,
+                                flags, rings, ring_bits, pay, full=full)
+        self.stats["frame_bytes"] += len(buf)
+        return buf
 
     # ------------------------------------------------------------ frames (rx)
     def _on_frame(self, sender: str, payload: bytes) -> None:
@@ -542,8 +638,14 @@ class ModeBNode:
             if self.wal is not None:
                 self.wal.log_frame(payload)
             self._apply_frame(frame, sender)
+        self._wake()
 
     def _apply_frame(self, frame: wire.Frame, sender: str = "?") -> None:
+        """Stage one decoded frame: payload/bookkeeping now, mirror writes
+        deferred to the once-per-tick fused apply (``_flush_mirrors``) —
+        frames arriving between ticks cost numpy work only, never a device
+        dispatch (round-2 weakness: ~20 scatters per frame on the manager
+        lock's hot path)."""
         sr = frame.sender_r
         if sr == self.r or not (0 <= sr < self.R) or frame.W != self.W:
             return
@@ -575,28 +677,40 @@ class ModeBNode:
         sel = rows >= 0
         if not sel.any():
             return
-        rows_idx = jnp.asarray(rows[sel], jnp.int32)
         keep = np.nonzero(sel)[0]
-        s = self.state
-        upd = {}
-        for f in wire.SCALARS:
-            col = jnp.asarray(frame.scalars[f][keep], jnp.int32)
-            upd[f] = getattr(s, f).at[sr, rows_idx].set(col)
-        fl = frame.flags[keep]
-        upd["coord_active"] = s.coord_active.at[sr, rows_idx].set(
-            jnp.asarray((fl & wire.FLAG_COORD_ACTIVE) > 0)
-        )
-        upd["coord_preparing"] = s.coord_preparing.at[sr, rows_idx].set(
-            jnp.asarray((fl & wire.FLAG_COORD_PREPARING) > 0)
-        )
-        for f in wire.RINGS:
-            block = jnp.asarray(frame.rings[f][keep].T, jnp.int32)  # [W, k]
-            upd[f] = getattr(s, f).at[sr, :, rows_idx].set(block.T)
-        for f in wire.RING_BITS:
-            block = jnp.asarray(frame.ring_bits[f][keep])  # [k, W]
-            upd[f] = getattr(s, f).at[sr, :, rows_idx].set(block)
-        self.state = s._replace(**upd)
-        self.stats["frames_applied"] += 1
+        self._pending_mirror.append((sr, rows[sel], keep, frame))
+        self.stats["frames_staged"] += 1
+
+    def _flush_mirrors(self) -> None:
+        """Apply every staged frame to the peer mirrors: one fused device
+        step per frame (all ~20 field writes in one program), rows padded
+        to a power of two so the jit cache stays bounded."""
+        if not self._pending_mirror:
+            return
+        pend, self._pending_mirror = self._pending_mirror, []
+        S, NR, NB = len(wire.SCALARS), len(wire.RINGS), len(wire.RING_BITS)
+        for sr, rows, keep, frame in pend:
+            n = rows.size
+            K = max(16, 1 << int(n - 1).bit_length())
+            rpad = np.full(K, self.G, np.int32)  # pad index G -> drop
+            rpad[:n] = rows
+            scal = np.zeros((S, K), np.int32)
+            for i, f in enumerate(wire.SCALARS):
+                scal[i, :n] = frame.scalars[f][keep]
+            flg = np.zeros(K, np.int32)
+            flg[:n] = frame.flags[keep]
+            rings = np.zeros((NR, K, self.W), np.int32)
+            for i, f in enumerate(wire.RINGS):
+                rings[i, :n] = frame.rings[f][keep]
+            bits = np.zeros((NB, K, self.W), bool)
+            for i, f in enumerate(wire.RING_BITS):
+                bits[i, :n] = frame.ring_bits[f][keep]
+            self.state = mirror_apply(
+                self.state, jnp.int32(sr), jnp.asarray(rpad),
+                jnp.asarray(scal), jnp.asarray(flg), jnp.asarray(rings),
+                jnp.asarray(bits),
+            )
+            self.stats["frames_applied"] += 1
 
     # ------------------------------------------------- missed birthing (whois)
     def _whois(self, gid: int, ask: str) -> None:
@@ -621,6 +735,11 @@ class ModeBNode:
     def _on_whois_reply(self, sender: str, p: dict) -> None:
         with self.lock:
             self._pending_whois.discard(int(p["gid"]))
+            if self.whois_birth is not None and not self.whois_birth(p["name"]):
+                # the control plane births this group (with proper state
+                # seeding); until then the group runs on the other members
+                self.stats["whois_birth_filtered"] += 1
+                return
             self.create_group(p["name"], [int(x) for x in p["members"]],
                               int(p["epoch"]))
 

@@ -1,0 +1,217 @@
+"""Device-resident KV application: execution fused behind the consensus tick.
+
+The reference's workload app (``gigapaxos/testing/TESTPaxosApp.java:60``)
+executes inside the JVM next to the acceptor; every decision still crosses
+the per-request handler stack.  Host apps here have the same shape — the
+decision stream leaves the device and ``Replicable.execute`` runs
+interpreted Python per request (``paxos/manager.py``), which caps e2e
+throughput orders of magnitude below the raw kernel.
+
+:class:`DeviceKV` moves the app itself into device arrays so the decision
+stream NEVER leaves the device:
+
+* app state — a direct-mapped KV cache per (replica, group):
+  ``key[R, G, S]`` / ``val[R, G, S]`` int32 (0 = empty slot; key k lives at
+  slot ``k & (S-1)``, last-writer-wins on collision, deterministic on every
+  replica by construction);
+* request descriptors — clients register ``rid -> (op, key, val)`` in a
+  hashed device table ``[T]`` (op PUT=1/GET=2/DEL=3); the tick's executed
+  rids gather their descriptors and a vectorized apply updates the KV
+  arrays for every group at once;
+* misses (descriptor evicted/never uploaded) surface in a ``miss`` mask so
+  the host can repair via its slow path — mirroring the dense design's
+  general fast-path/slow-path split (SURVEY §7 hard part f).
+
+``fused_step`` runs ``paxos_tick`` and the KV apply in ONE jitted program —
+XLA fuses the gather/scatter chain with the tick's phase-4 extraction, so
+"execute" costs one more fused elementwise pass over ``[R, W, G]``, not a
+host round-trip per decision.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.tick import TickInbox, paxos_tick_impl
+from ..types import NO_REQUEST
+
+OP_NONE = 0
+OP_PUT = 1
+OP_GET = 2
+OP_DEL = 3
+
+I32 = jnp.int32
+
+
+class DeviceKVState(NamedTuple):
+    """Dense app state + request-descriptor table (all device arrays)."""
+
+    key: jnp.ndarray   # i32 [R, G, S]   stored key per slot (0 = empty)
+    val: jnp.ndarray   # i32 [R, G, S]
+    t_rid: jnp.ndarray  # i32 [T] descriptor table: registered rid (0 = none)
+    t_op: jnp.ndarray   # i32 [T]
+    t_key: jnp.ndarray  # i32 [T]
+    t_val: jnp.ndarray  # i32 [T]
+
+    @property
+    def slots(self) -> int:
+        return self.key.shape[2]
+
+    @property
+    def table(self) -> int:
+        return self.t_rid.shape[0]
+
+
+def init_kv(n_replicas: int, n_groups: int, slots: int = 16,
+            table: int = 1 << 16) -> DeviceKVState:
+    assert slots & (slots - 1) == 0 and table & (table - 1) == 0
+    R, G = n_replicas, n_groups
+    return DeviceKVState(
+        key=jnp.zeros((R, G, slots), I32),
+        val=jnp.zeros((R, G, slots), I32),
+        t_rid=jnp.zeros((table,), I32),
+        t_op=jnp.zeros((table,), I32),
+        t_key=jnp.zeros((table,), I32),
+        t_val=jnp.zeros((table,), I32),
+    )
+
+
+def register_requests(kv: DeviceKVState, rids, ops, keys, vals) -> DeviceKVState:
+    """Upload request descriptors (host batch -> one scatter).  Clients call
+    this before proposing the rids; collisions evict (the evicted request
+    will execute as a miss and fall back to the host slow path)."""
+    rids = jnp.asarray(rids, I32)
+    idx = jnp.bitwise_and(rids, kv.table - 1)
+    return kv._replace(
+        t_rid=kv.t_rid.at[idx].set(rids),
+        t_op=kv.t_op.at[idx].set(jnp.asarray(ops, I32)),
+        t_key=kv.t_key.at[idx].set(jnp.asarray(keys, I32)),
+        t_val=kv.t_val.at[idx].set(jnp.asarray(vals, I32)),
+    )
+
+
+def kv_apply(kv: DeviceKVState, exec_req: jnp.ndarray,
+             exec_count: jnp.ndarray) -> Tuple[DeviceKVState, jnp.ndarray,
+                                               jnp.ndarray]:
+    """Vectorized execution of one tick's decision stream.
+
+    exec_req: i32 [R, W, G] executed rids in window order (0 = none);
+    exec_count: i32 [R, G].
+    Returns (kv', responses i32 [R, W, G] — PUT echoes the value, GET/DEL
+    return the pre-op value (0 = absent) — and miss bool [R, W, G]).
+
+    Window plane j executes slot base+j, so planes apply in order: a
+    ``lax.scan`` over the W axis (W is small and static) threads the store
+    through the planes — each step is fully vectorized over [R, G], and XLA
+    unrolls/fuses the short scan into the surrounding program.  This is the
+    TPU idiom for the reference's in-order ``execute`` loop
+    (PaxosInstanceStateMachine.java:1755-1842) with read-your-writes inside
+    one tick's batch.
+    """
+    from jax import lax
+
+    R, W, G = exec_req.shape
+    S = kv.slots
+    ji = jnp.arange(W, dtype=I32)
+    valid = (exec_req != NO_REQUEST) & (ji[None, :, None] < exec_count[:, None, :])
+
+    tix = jnp.bitwise_and(exec_req, kv.table - 1)  # [R, W, G]
+    hit = valid & (kv.t_rid[tix] == exec_req)
+    op = jnp.where(hit, kv.t_op[tix], OP_NONE)
+    k = kv.t_key[tix]
+    v = kv.t_val[tix]
+    slot = jnp.bitwise_and(k, S - 1)  # [R, W, G]
+
+    rr = jnp.arange(R, dtype=I32)[:, None]
+    gg = jnp.arange(G, dtype=I32)[None, :]
+
+    def plane(carry, xs):
+        key_s, val_s = carry  # [R, G, S]
+        op_j, k_j, v_j, slot_j = xs  # [R, G]
+        cur_key = key_s[rr, gg, slot_j]
+        cur_val = val_s[rr, gg, slot_j]
+        present = cur_key == k_j
+        resp = jnp.where(
+            op_j == OP_PUT, v_j, jnp.where(present, cur_val, 0)
+        )
+        wr = (op_j == OP_PUT) | (op_j == OP_DEL)
+        wslot = jnp.where(wr, slot_j, S)  # S -> drop
+        nk = jnp.where(op_j == OP_DEL, 0, k_j)
+        nv = jnp.where(op_j == OP_DEL, 0, v_j)
+        key_s = key_s.at[rr, gg, wslot].set(nk, mode="drop")
+        val_s = val_s.at[rr, gg, wslot].set(nv, mode="drop")
+        return (key_s, val_s), resp
+
+    xs = (op.transpose(1, 0, 2), k.transpose(1, 0, 2),
+          v.transpose(1, 0, 2), slot.transpose(1, 0, 2))
+    (key_s, val_s), resps = lax.scan(plane, (kv.key, kv.val), xs)
+    responses = jnp.where(hit, resps.transpose(1, 0, 2), 0)
+    kv2 = kv._replace(key=key_s, val=val_s)
+    miss = valid & ~hit
+    return kv2, responses, miss
+
+
+def fused_step(state, kv: DeviceKVState, inbox: TickInbox, own_row: int = -1):
+    """One consensus tick + device app execution in a single program."""
+    new_state, out = paxos_tick_impl(state, inbox, own_row)
+    kv2, responses, miss = kv_apply(kv, out.exec_req, out.exec_count)
+    return new_state, kv2, out, responses, miss
+
+
+fused_step_jit = jax.jit(fused_step, donate_argnums=(0, 1),
+                         static_argnums=(3,))
+
+
+class DeviceKVApp:
+    """Replicable-shaped wrapper so the control plane can checkpoint /
+    restore device KV groups (row-granular pulls; the hot path never calls
+    ``execute`` — that is the whole point).
+
+    ``row_of(name)`` maps service names to group rows (wire it to the
+    manager's RowAllocator).
+    """
+
+    def __init__(self, kv: DeviceKVState, replica: int,
+                 row_of=None):
+        self.kv = kv
+        self.replica = replica
+        self.row_of = row_of or (lambda name: None)
+
+    def execute(self, name: str, request: bytes, request_id: int) -> bytes:
+        raise NotImplementedError(
+            "device app decisions execute on-device via fused_step; the "
+            "host slow path is only for descriptor misses"
+        )
+
+    def checkpoint(self, name: str) -> bytes:
+        row = self.row_of(name)
+        if row is None:
+            return b""
+        keys = np.asarray(self.kv.key[self.replica, row])
+        vals = np.asarray(self.kv.val[self.replica, row])
+        live = keys != 0
+        return json.dumps({
+            "k": keys[live].tolist(), "v": vals[live].tolist(),
+        }).encode()
+
+    def restore(self, name: str, state: bytes) -> None:
+        row = self.row_of(name)
+        if row is None:
+            return
+        S = self.kv.slots
+        keys = np.zeros(S, np.int32)
+        vals = np.zeros(S, np.int32)
+        if state:
+            d = json.loads(state.decode())
+            for k, v in zip(d["k"], d["v"]):
+                keys[k & (S - 1)] = k
+                vals[k & (S - 1)] = v
+        self.kv = self.kv._replace(
+            key=self.kv.key.at[self.replica, row].set(jnp.asarray(keys)),
+            val=self.kv.val.at[self.replica, row].set(jnp.asarray(vals)),
+        )

@@ -30,12 +30,14 @@ from __future__ import annotations
 import json
 import queue
 import socket
+import ssl as _ssl
 import struct
 import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from ..utils.profiler import profiler
+from .security import TransportSecurity
 
 KIND_JSON = 0
 KIND_BYTES = 1
@@ -100,10 +102,18 @@ class _Peer:
         try:
             s = socket.create_connection(addr, timeout=self.t.connect_timeout_s)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self.t.client_ssl_ctx is not None:
+                # TLS handshake before any frame (SERVER_AUTH verifies the
+                # peer; MUTUAL_AUTH also presents our certificate)
+                s = self.t.client_ssl_ctx.wrap_socket(s)
             hello = json.dumps({"node": self.t.node_id}).encode()
             _send_frame(s, KIND_JSON, hello)
+            s.settimeout(None)
             return s
-        except OSError:
+        except (OSError, _ssl.SSLError):
+            self.t._count("tls_connect_failures"
+                          if self.t.client_ssl_ctx is not None else
+                          "connect_failures")
             return None
 
     def _run(self) -> None:
@@ -166,6 +176,7 @@ class Transport:
         send_queue_cap: int = 4096,
         connect_timeout_s: float = 2.0,
         max_connect_attempts: int = 5,
+        security: Optional[TransportSecurity] = None,
     ):
         self.node_id = node_id
         self.demux = demux
@@ -173,6 +184,13 @@ class Transport:
         self.send_queue_cap = send_queue_cap
         self.connect_timeout_s = connect_timeout_s
         self.max_connect_attempts = max_connect_attempts
+        self.security = security
+        self.server_ssl_ctx = (
+            security.server_context() if security is not None else None
+        )
+        self.client_ssl_ctx = (
+            security.client_context() if security is not None else None
+        )
         self.closed = False
         self._peers: Dict[str, _Peer] = {}
         self._plock = threading.Lock()
@@ -244,6 +262,18 @@ class Transport:
     def _read_loop(self, conn: socket.socket) -> None:
         sender = "?"
         try:
+            if self.server_ssl_ctx is not None:
+                # handshake on the reader thread so a slow (or malicious)
+                # client cannot stall the acceptor
+                try:
+                    conn.settimeout(self.connect_timeout_s * 2)
+                    conn = self.server_ssl_ctx.wrap_socket(conn, server_side=True)
+                    conn.settimeout(None)
+                except (_ssl.SSLError, OSError):
+                    # unauthenticated peer (e.g. no client cert under
+                    # MUTUAL_AUTH): reject the connection
+                    self._count("tls_rejects")
+                    return
             first = _recv_frame(conn)
             if first is None:
                 return

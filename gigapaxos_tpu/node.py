@@ -64,6 +64,7 @@ class InProcessCluster:
         start_fd: bool = False,
         coordinator: str = "paxos",
         spare_replica_slots: int = 0,
+        spare_rc_slots: int = 0,
     ):
         self.cfg = cfg
         active_ids = cfg.nodes.active_ids()
@@ -93,15 +94,21 @@ class InProcessCluster:
         self.driver = TickDriver(self.manager).start()
 
         # ---------------- RC plane (the DB replicated on its own data plane)
-        self.rc_manager = PaxosManager(
-            cfg, len(rc_ids), [ReconfiguratorDB(r) for r in rc_ids], wal=rc_wal
-        )
+        # spare RC slots = provisioned capacity for runtime RC-node adds
+        # (Reconfigurator.handleReconfigureRCNodeConfig:1044)
+        rc_apps = [ReconfiguratorDB(r) for r in rc_ids] + [
+            ReconfiguratorDB(f"_spare{i}") for i in range(spare_rc_slots)
+        ]
+        self.rc_manager = PaxosManager(cfg, len(rc_apps), rc_apps, wal=rc_wal)
         self.rdb = RepliconfigurableReconfiguratorDB(
             self.rc_manager, rc_ids, k=rc_group_size
         )
         self.rc_driver = TickDriver(self.rc_manager).start()
 
         # ---------------- per-node control plane endpoints
+        from .net.security import TransportSecurity
+
+        security = TransportSecurity.from_config(cfg.ssl)
         self.nodemap = NodeMap(cfg.nodes)
         self.actives: Dict[str, ActiveReplica] = {}
         self.reconfigurators: Dict[str, Reconfigurator] = {}
@@ -109,7 +116,8 @@ class InProcessCluster:
         self._liveness: Dict[str, bool] = {n: True for n in rc_ids + active_ids}
 
         for a in active_ids:
-            m = Messenger(a, cfg.nodes.actives[a], self.nodemap)
+            m = Messenger(a, cfg.nodes.actives[a], self.nodemap,
+                          security=security)
             # port 0 binds ephemerally: publish the real port, both in this
             # cluster's nodemap and back into cfg.nodes so clients built
             # from the same config resolve correctly
@@ -121,7 +129,8 @@ class InProcessCluster:
                 rc_group_size=rc_group_size,
             )
         for r in rc_ids:
-            m = Messenger(r, cfg.nodes.reconfigurators[r], self.nodemap)
+            m = Messenger(r, cfg.nodes.reconfigurators[r], self.nodemap,
+                          security=security)
             self.nodemap.add(r, cfg.nodes.reconfigurators[r][0], m.port)
             cfg.nodes.reconfigurators[r] = (cfg.nodes.reconfigurators[r][0], m.port)
             self.reconfigurators[r] = Reconfigurator(
@@ -179,6 +188,46 @@ class InProcessCluster:
         if slot is not None:
             self.manager.set_alive(slot, False)  # dead until rebound
         self.cfg.nodes.actives.pop(node_id, None)
+        self._liveness[node_id] = False
+
+    def add_rc_endpoint(self, node_id: str,
+                        bind=("127.0.0.1", 0)) -> Reconfigurator:
+        """Local wiring for a runtime RC-node add: bind a spare RC-plane
+        slot and start the node's control endpoint.  Pair with an admin
+        ``add_reconfigurator`` request so the committed NC-RC change splices
+        the ring everywhere (Reconfigurator.java:1044)."""
+        slot = self.rdb.bind_rc(node_id)
+        if slot is None:
+            raise RuntimeError("no spare RC slots provisioned")
+        self.rc_manager.set_alive(slot, True)
+        from .net.security import TransportSecurity
+
+        m = Messenger(node_id, bind, self.nodemap,
+                      security=TransportSecurity.from_config(self.cfg.ssl))
+        self.nodemap.add(node_id, bind[0], m.port)
+        self.cfg.nodes.reconfigurators[node_id] = (bind[0], m.port)
+        k = (next(iter(self.reconfigurators.values())).k
+             if self.reconfigurators else 3)
+        rc = Reconfigurator(
+            node_id, m, self.rdb, self.cfg.nodes.active_ids(),
+            replicas_per_name=k,
+            demand_profile_factory=self._demand_profile_factory,
+            is_node_up=lambda n: self._liveness.get(n, True),
+        )
+        self.reconfigurators[node_id] = rc
+        self._liveness[node_id] = True
+        return rc
+
+    def remove_rc_endpoint(self, node_id: str) -> None:
+        """Tear down a removed reconfigurator's endpoint (after the admin
+        ``remove_reconfigurator`` request re-homed its records)."""
+        rc = self.reconfigurators.pop(node_id, None)
+        if rc is not None:
+            rc.close()
+        slot = self.rdb.unbind_rc(node_id)
+        if slot is not None:
+            self.rc_manager.set_alive(slot, False)
+        self.cfg.nodes.reconfigurators.pop(node_id, None)
         self._liveness[node_id] = False
 
     # ----------------------------------------------------------------- admin

@@ -44,13 +44,17 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..types import GroupStatus, NO_REQUEST
 from .ballot import bal_ge, bal_gt
 from .window import gather_planes
 
 I32 = jnp.int32
-NEG_INF = jnp.int32(-(2**31))
+# numpy scalar, NOT jnp: a module-level jnp value would initialize the
+# default backend at import time (and hang the importer for the whole
+# backend-init timeout when the TPU tunnel is down)
+NEG_INF = np.int32(-(2**31))
 
 
 class TickInbox(NamedTuple):

@@ -115,9 +115,21 @@ class ActiveReplica:
             collections.OrderedDict()
         )
         self._dedup_cap = 4096
+        #: insertion time of in-flight (None) markers: markers whose client
+        #: died before the callback ever fires must age out, or a map full
+        #: of in-flight entries grows unbounded (advisor round 2)
+        self._dedup_born: Dict[tuple, float] = {}
+        self._dedup_inflight_ttl_s = 60.0
         self._dedup_lock = threading.Lock()
+        #: anycast forwards awaiting an actives answer: qrid -> (reply_to, p)
+        self._any_pending: "collections.OrderedDict[int, tuple]" = (
+            collections.OrderedDict()
+        )
+        self._any_lock = threading.Lock()
+        self._any_next = 1 << 40  # disjoint from client rids
         for ptype, h in [
             (pkt.APP_REQUEST, self._on_app_request),
+            (pkt.ACTIVES_RESPONSE, self._on_actives_response),
             (pkt.STOP_EPOCH, self._on_stop_epoch),
             (pkt.START_EPOCH, self._on_start_epoch),
             (pkt.DROP_EPOCH, self._on_drop_epoch),
@@ -135,6 +147,16 @@ class ActiveReplica:
     def _on_app_request(self, sender: str, p: dict) -> None:
         pkt.register_client(self.m.nodemap, p)
         name, rid = p["name"], p["rid"]
+        # anycast entry (sendRequestAnycast, ReconfigurableAppClientAsync
+        # :1357): the client sent to an arbitrary active; if we don't host
+        # the name, resolve its actives from the RC plane and forward — the
+        # hosting replica answers the client directly via reply_to
+        reply_to = p.get("reply_to") or sender
+        if (p.get("anycast") and not p.get("fwd")
+                and self.coord.current_epoch(name) is None):
+            self._anycast_forward(reply_to, p)
+            return
+        sender = reply_to
         # retransmission dedup: the client reuses its rid on retry, so a
         # duplicate arriving while the first copy is in flight is dropped
         # (its response will carry the same rid) and one arriving after
@@ -147,12 +169,13 @@ class ActiveReplica:
                     self.m.send(sender, cached)
                 return
             self._req_dedup[key] = None
+            self._dedup_born[key] = time.monotonic()
             if len(self._req_dedup) > self._dedup_cap:
-                # evict the oldest COMPLETED entry — dropping an in-flight
-                # (None) marker would let a retransmission of a slow request
-                # start the second proposal the map exists to prevent.  Scan
-                # stops at the first completed key (usually the very first),
-                # no full-copy of the map on the hot path.
+                # evict the oldest COMPLETED entry — dropping a live
+                # in-flight (None) marker would let a retransmission of a
+                # slow request start the second proposal the map exists to
+                # prevent.  Scan stops at the first completed key (usually
+                # the very first), no full-copy of the map on the hot path.
                 victim = None
                 for k in self._req_dedup:
                     if self._req_dedup[k] is not None:
@@ -160,6 +183,18 @@ class ActiveReplica:
                         break
                 if victim is not None:
                     del self._req_dedup[victim]
+                else:
+                    # all in-flight: age out markers past the max plausible
+                    # commit latency (dead clients / wedged groups) so the
+                    # map stays bounded under pathological load
+                    now = time.monotonic()
+                    stale = [
+                        k for k, born in self._dedup_born.items()
+                        if now - born > self._dedup_inflight_ttl_s
+                    ]
+                    for k in stale:
+                        self._req_dedup.pop(k, None)
+                        self._dedup_born.pop(k, None)
         try:
             self._handle_app_request(sender, p, key)
         except Exception:
@@ -168,6 +203,7 @@ class ActiveReplica:
             # this rid forever
             with self._dedup_lock:
                 self._req_dedup.pop(key, None)
+                self._dedup_born.pop(key, None)
             raise
 
     def _handle_app_request(self, sender: str, p: dict, key) -> None:
@@ -194,6 +230,7 @@ class ActiveReplica:
                                                 "name": name}
                     else:
                         self._req_dedup.pop(key, None)
+                    self._dedup_born.pop(key, None)
                 return
             if req_id < 0 or resp is None:
                 # epoch stopped underneath us: client must re-resolve actives
@@ -219,6 +256,40 @@ class ActiveReplica:
             else:
                 with self._dedup_lock:
                     self._req_dedup.pop(key, None)
+                    self._dedup_born.pop(key, None)
+
+    def _anycast_forward(self, reply_to: str, p: dict) -> None:
+        """Resolve the name's actives from its RC group, then re-send the
+        request to a hosting replica with explicit client reply routing."""
+        name = p["name"]
+        with self._any_lock:
+            qrid = self._any_next
+            self._any_next += 1
+            self._any_pending[qrid] = (reply_to, dict(p))
+            while len(self._any_pending) > 1024:
+                self._any_pending.popitem(last=False)
+        rcs = self.rc_ring.replicated_servers(name, self.rc_k)
+        self.m.send(rcs[0], pkt.request_active_replicas(name, qrid))
+
+    def _on_actives_response(self, sender: str, p: dict) -> None:
+        with self._any_lock:
+            ent = self._any_pending.pop(p.get("rid"), None)
+        if ent is None:
+            return
+        reply_to, req = ent
+        if not p.get("ok") or not p.get("actives"):
+            self.m.send(reply_to, {
+                "type": pkt.APP_RESPONSE, "rid": req["rid"], "ok": False,
+                "error": "not_active", "name": req["name"],
+            })
+            return
+        for a, addr in (p.get("addrs") or {}).items():
+            if self.m.nodemap(a) is None:
+                self.m.nodemap.add(a, addr[0], int(addr[1]))
+        target = p["actives"][0]
+        req["reply_to"] = reply_to
+        req["fwd"] = 1
+        self.m.send(target, req)
 
     def _finish_request(self, sender: str, key, packet: dict,
                         cache: bool) -> None:
@@ -230,6 +301,7 @@ class ActiveReplica:
                 self._req_dedup[key] = packet
             else:
                 self._req_dedup.pop(key, None)
+            self._dedup_born.pop(key, None)
         self.m.send(sender, packet)
 
     def _register_demand(self, name: str, sender: str, epoch: int) -> None:

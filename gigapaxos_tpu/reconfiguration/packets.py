@@ -34,10 +34,23 @@ DELETE_RESPONSE = "delete_response"
 ACTIVES_RESPONSE = "actives_response"
 RECONFIGURE_RESPONSE = "reconfigure_response"
 
+# batched creates: one RC commit per batch per RC group
+# (reconfigurationpackets/BatchedCreateServiceName.java)
+CREATE_BATCH = "batched_create_service_name"
+CREATE_BATCH_RESPONSE = "batched_create_response"
+
+#: pseudo-name resolving the WHOLE active pool (anycast support —
+#: ReconfigurableAppClientAsync.ALL_ACTIVES / sendRequestAnycast:1357)
+ALL_ACTIVES = "*all_actives*"
+
 # admin <-> reconfigurator (node-config elasticity,
 # ReconfigureActiveNodeConfig / Reconfigurator.handleReconfigureRCNodeConfig:1044)
 ADD_ACTIVE = "add_active"
 REMOVE_ACTIVE = "remove_active"
+#: RC-node elasticity (ReconfigureRCNodeConfig,
+#: Reconfigurator.handleReconfigureRCNodeConfig:1044)
+ADD_RC = "add_reconfigurator"
+REMOVE_RC = "remove_reconfigurator"
 NODE_CONFIG_RESPONSE = "node_config_response"
 
 # client <-> active replica
@@ -86,6 +99,17 @@ def create_service_name(name: str, initial_state: bytes, rid: int) -> dict:
         "type": CREATE_SERVICE_NAME,
         "name": name,
         "initial_state": b64e(initial_state),
+        "rid": rid,
+    }
+
+
+def create_batch(creates, rid: int) -> dict:
+    """creates: list of (name, initial_state bytes)."""
+    return {
+        "type": CREATE_BATCH,
+        "creates": [
+            {"name": n, "initial_state": b64e(s)} for n, s in creates
+        ],
         "rid": rid,
     }
 

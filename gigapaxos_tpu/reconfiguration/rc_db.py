@@ -40,8 +40,11 @@ from .records import RCState, ReconfigurationRecord
 #: paxos-group-name prefix for RC-group instances
 RC_GROUP_PREFIX = "_RC:"
 #: the special node-config record/group replicated on ALL reconfigurators
-#: (the reference's AbstractReconfiguratorDB.RecordNames.RC_NODES)
+#: (the reference's AbstractReconfiguratorDB.RecordNames.AR_NODES)
 NC_RECORD = "_NC"
+#: the reconfigurator-pool record (RecordNames.RC_NODES — RC-node
+#: add/remove at runtime, Reconfigurator.handleReconfigureRCNodeConfig:1044)
+NC_RC_RECORD = "_NC_RC"
 
 
 class ReconfiguratorDB(Replicable):
@@ -96,6 +99,35 @@ class ReconfiguratorDB(Replicable):
         op = cmd["op"]
         name = cmd["name"]
         rec = self.records.get(name)
+        if op in ("add_rc", "remove_rc"):
+            if name != NC_RC_RECORD:
+                return {"ok": False, "error": "nc_rc_only"}
+            if rec is None:
+                rec = self.records[name] = ReconfigurationRecord(
+                    name=name, actives=sorted(cmd.get("seed_pool", []))
+                )
+            node = cmd["node"]
+            pool = set(rec.actives)  # the RC pool rides the actives field
+            if op == "add_rc":
+                pool.add(node)
+            else:
+                pool.discard(node)
+                min_pool = int(cmd.get("min_pool", 1))
+                if len(pool) < min_pool:
+                    return {"ok": False, "error": "pool_too_small",
+                            "pool": rec.actives}
+            rec.actives = sorted(pool)
+            rec.epoch += 1
+            return {"ok": True, "pool": rec.actives, "epoch": rec.epoch}
+        if op == "record_install":
+            # idempotent record carry-over into a re-homed RC group after a
+            # ring splice (the reference re-hashes record ownership the same
+            # way when RC nodes change, Reconfigurator.java:1044)
+            incoming = ReconfigurationRecord.from_dict(cmd["record"])
+            if rec is not None and rec.epoch >= incoming.epoch:
+                return {"ok": True, "installed": False, "epoch": rec.epoch}
+            self.records[name] = incoming
+            return {"ok": True, "installed": True, "epoch": incoming.epoch}
         if op in ("add_active", "remove_active"):
             if name != NC_RECORD:
                 # node-config ops are only valid on the NC record; applied to
@@ -137,6 +169,21 @@ class ReconfiguratorDB(Replicable):
             )
             self.records[name] = rec
             return {"ok": True, "epoch": rec.epoch}
+        if op == "create_batch":
+            # one committed command creates every record of the batch
+            # (BatchedCreateServiceName.java applied atomically per RC group)
+            results = {}
+            for c in cmd.get("creates", []):
+                n = c["name"]
+                if n in self.records:
+                    results[n] = {"ok": False, "error": "exists",
+                                  "epoch": self.records[n].epoch}
+                else:
+                    self.records[n] = ReconfigurationRecord(
+                        name=n, epoch=0, actives=sorted(c["actives"]),
+                    )
+                    results[n] = {"ok": True, "epoch": 0}
+            return {"ok": True, "results": results}
         if rec is None:
             return {"ok": False, "error": "unknown"}
         if op == "reconfigure_intent":
@@ -222,10 +269,10 @@ class RepliconfigurableReconfiguratorDB:
     # ---------------------------------------------------------------- groups
     def rc_group_of(self, name: str) -> List[str]:
         """The k reconfigurators owning ``name`` (its RC group).  The
-        node-config record is replicated on ALL reconfigurators (the
+        node-config records are replicated on ALL reconfigurators (the
         reference's RC_NODES/AR_NODES groups span every RC,
         ReconfigurableNode.java:180-188)."""
-        if name == NC_RECORD:
+        if name in (NC_RECORD, NC_RC_RECORD):
             return list(self.rc_ids)
         return self.ring.replicated_servers(name, self.k)
 
@@ -269,3 +316,33 @@ class RepliconfigurableReconfiguratorDB:
 
     def db_of(self, rc_id: str) -> ReconfiguratorDB:
         return self.manager.apps[self._slot[rc_id]]
+
+    # ------------------------------------------------- RC-node elasticity
+    def bind_rc(self, node_id: str) -> Optional[int]:
+        """Bind a new reconfigurator id to a spare RC-plane replica slot
+        (the manager must have been provisioned with spare slots)."""
+        if node_id in self._slot:
+            return self._slot[node_id]
+        used = set(self._slot.values())
+        for s in range(self.manager.R):
+            if s not in used:
+                self._slot[node_id] = s
+                app = self.manager.apps[s]
+                if isinstance(app, ReconfiguratorDB):
+                    app.node_id = node_id
+                    app.scope = (
+                        lambda sname, gname:
+                        self._pax_group(self.rc_group_of(sname)) == gname
+                    )
+                return s
+        return None
+
+    def unbind_rc(self, node_id: str) -> Optional[int]:
+        return self._slot.pop(node_id, None)
+
+    def update_pool(self, pool: List[str]) -> None:
+        """Splice the consistent-hash ring to a committed RC pool.  Slots
+        for departed nodes stay bound until ``unbind_rc`` so in-flight
+        commits through old groups still resolve."""
+        self.rc_ids = sorted(pool)
+        self.ring = ConsistentHashRing(self.rc_ids)

@@ -35,7 +35,7 @@ from ..protocoltask.executor import ProtocolExecutor, ProtocolTask
 from . import packets as pkt
 from .consistent_hashing import ConsistentHashRing
 from .demand import AbstractDemandProfile, DemandProfile
-from .rc_db import NC_RECORD, RepliconfigurableReconfiguratorDB
+from .rc_db import NC_RC_RECORD, NC_RECORD, RepliconfigurableReconfiguratorDB
 from .records import RCState
 
 
@@ -175,6 +175,36 @@ class WaitPrimaryExecution(ProtocolTask):
         return [], True  # explicit completion event (unused today)
 
 
+class RCMigrateTask(ProtocolTask):
+    """After an RC ring splice, sweep local records into their re-homed RC
+    groups with idempotent ``record_install`` commits until every record
+    this node should drive is installed (Reconfigurator.java:1044's record
+    re-hash, made crash-tolerant by retrying sweeps)."""
+
+    period_s = 1.0
+    max_restarts = 30
+
+    def __init__(self, rc: "Reconfigurator", change_epoch: int):
+        self.rc = rc
+        self.change_epoch = change_epoch
+
+    @property
+    def key(self) -> str:
+        return f"RCMigrate:{self.change_epoch}"
+
+    def start(self):
+        self.rc._rc_migrate_once()
+        return []
+
+    def restart(self):
+        if self.rc._rc_migrate_once() == 0:
+            self.rc.executor.cancel(self.key)
+        return []
+
+    def handle(self, event):
+        return [], True
+
+
 class NodeDrainTask(ProtocolTask):
     """Retrying drain of a removed active: sweeps until no record this RC
     can see still lists the node (names that were mid-reconfiguration at
@@ -228,9 +258,18 @@ class Reconfigurator:
         self.is_node_up = is_node_up or (lambda n: True)
         #: in-flight client replies: name -> (client_id, reply_packet_base)
         self._pending_reply: Dict[str, tuple] = {}
+        #: records already re-homed after an RC ring splice (convergence
+        #: marker for RCMigrateTask sweeps)
+        self._rc_migrated: set = set()
+        #: delegated create_batch sub-requests: sub-rid -> aggregation hook
+        self._sub_batches: Dict[int, list] = {}
+        self._sub_done: Dict[int, Callable[[dict], None]] = {}
+        self._sub_next = 1 << 41  # disjoint from client and anycast rids
         self.executor = ProtocolExecutor(self.m.send, name=f"rc-{node_id}")
         for ptype, h in [
             (pkt.CREATE_SERVICE_NAME, self._on_create),
+            (pkt.CREATE_BATCH, self._on_create_batch),
+            (pkt.CREATE_BATCH_RESPONSE, self._on_create_batch_response),
             (pkt.DELETE_SERVICE_NAME, self._on_delete),
             (pkt.REQUEST_ACTIVE_REPLICAS, self._on_request_actives),
             (pkt.CLIENT_RECONFIGURE, self._on_client_reconfigure),
@@ -240,6 +279,8 @@ class Reconfigurator:
             (pkt.ACK_DROP_EPOCH, self._route_ack("WaitAckDropEpoch")),
             (pkt.ADD_ACTIVE, self._on_node_config),
             (pkt.REMOVE_ACTIVE, self._on_node_config),
+            (pkt.ADD_RC, self._on_rc_node_config),
+            (pkt.REMOVE_RC, self._on_rc_node_config),
         ]:
             self.m.register(ptype, h)
 
@@ -255,6 +296,22 @@ class Reconfigurator:
             name, min(self.k, len(self.actives_pool))
         )
 
+    def _ensure_owner(self, name: str, sender: str, p: dict) -> bool:
+        """With more reconfigurators than k, a client packet may land on an
+        RC outside the name's group — forward it to the primary with client
+        reply routing preserved (the reference forwards RCRecordRequests to
+        the responsible group the same way).  Returns True when this RC
+        should handle the packet locally."""
+        if self.node_id in self.rdb.rc_group_of(name):
+            return True
+        if p.get("rc_fwd"):
+            return True  # one hop max: handle (and possibly fail) here
+        p2 = dict(p)
+        p2["reply_to"] = p.get("reply_to") or sender
+        p2["rc_fwd"] = 1
+        self.m.send(self.rdb.primary_of(name), p2)
+        return False
+
     def _route_ack(self, task: str):
         def h(sender: str, p: dict) -> None:
             self.executor.handle_event(f"{task}:{p['name']}:{p['epoch']}", p)
@@ -263,7 +320,10 @@ class Reconfigurator:
     # ------------------------------------------------------------ name create
     def _on_create(self, sender: str, p: dict) -> None:
         pkt.register_client(self.m.nodemap, p)
+        sender = p.get("reply_to") or sender
         name, rid = p["name"], p["rid"]
+        if not self._ensure_owner(name, sender, p):
+            return
         state = pkt.b64d(p["initial_state"]) or b""
         actives = self.initial_actives(name)
 
@@ -299,10 +359,131 @@ class Reconfigurator:
             committed, proposer=self.node_id,
         )
 
+    # ------------------------------------------------------------ batch create
+    def _on_create_batch(self, sender: str, p: dict) -> None:
+        """handleCreateServiceName's batched flavor: ONE paxos commit per RC
+        group creates every record of the batch, then per-name StartEpochs
+        run concurrently (BatchedCreateServiceName.java; issued by the
+        client library which packs creates,
+        ReconfigurableAppClientAsync.java:35)."""
+        pkt.register_client(self.m.nodemap, p)
+        sender = p.get("reply_to") or sender
+        rid, creates = p["rid"], p.get("creates", [])
+        if not creates:
+            self.m.send(sender, {"type": pkt.CREATE_BATCH_RESPONSE,
+                                 "rid": rid, "ok": False,
+                                 "error": "empty_batch"})
+            return
+        results: Dict[str, dict] = {}
+        total = len(creates)
+        lock = threading.Lock()
+
+        def name_done(n: str, entry: dict) -> None:
+            with lock:
+                if n in results:
+                    return
+                results[n] = entry
+                finished = len(results) == total
+            if finished:
+                self.m.send(sender, {
+                    "type": pkt.CREATE_BATCH_RESPONSE, "rid": rid,
+                    "ok": all(r.get("ok") for r in results.values()),
+                    "results": results,
+                })
+
+        # partition server-side by RC group: every partition is one commit
+        parts: Dict[tuple, list] = {}
+        for c in creates:
+            key = tuple(self.rdb.rc_group_of(c["name"]))
+            parts.setdefault(key, []).append({
+                "name": c["name"],
+                "actives": self.initial_actives(c["name"]),
+                "initial_state": c.get("initial_state"),
+            })
+
+        # partitions whose group excludes this RC cannot commit here (a
+        # non-member's proposal never fires its callback in Mode B):
+        # delegate the sub-batch to that group's primary and fold its
+        # response back into ours (_ensure_owner's batched analog)
+        foreign = {g: e for g, e in parts.items()
+                   if self.node_id not in g and not p.get("rc_fwd")}
+        for group, entries in foreign.items():
+            del parts[group]
+            sub_rid = self._sub_rid()
+            with self._lock:
+                self._sub_batches[sub_rid] = [e["name"] for e in entries]
+
+                def sub_done(results_by_name: dict, entries=entries) -> None:
+                    for e in entries:
+                        n = e["name"]
+                        name_done(n, results_by_name.get(
+                            n, {"ok": False, "error": "forward_failed"}
+                        ))
+
+                self._sub_done[sub_rid] = sub_done
+            self.m.send(group[0], {
+                "type": pkt.CREATE_BATCH, "rid": sub_rid, "rc_fwd": 1,
+                "reply_to": self.node_id,
+                "creates": [
+                    {"name": e["name"],
+                     "initial_state": e["initial_state"]}
+                    for e in entries
+                ],
+            })
+
+        for entries in parts.values():
+            def committed(result: dict, entries=entries) -> None:
+                if not result.get("ok"):
+                    for e in entries:
+                        name_done(e["name"], {
+                            "ok": False,
+                            "error": result.get("error", "failed"),
+                        })
+                    return
+                per = result.get("results", {})
+                for e in entries:
+                    n = e["name"]
+                    r = per.get(n, {"ok": False, "error": "failed"})
+                    if not r.get("ok"):
+                        name_done(n, dict(r))
+                        continue
+                    self.executor.cancel(f"WaitAckStartEpoch:{n}:0")
+                    self.executor.schedule(WaitAckStartEpoch(
+                        self, n, 0, e["actives"], -1, [],
+                        pkt.b64d(e["initial_state"]) or b"",
+                        lambda n=n, e=e: name_done(
+                            n, {"ok": True, "actives": e["actives"]}
+                        ),
+                    ))
+
+            self.rdb.commit(
+                entries[0]["name"],
+                {"op": "create_batch", "name": entries[0]["name"],
+                 "creates": entries, "origin": self.node_id},
+                committed, proposer=self.node_id,
+            )
+
+    def _sub_rid(self) -> int:
+        with self._lock:
+            self._sub_next += 1
+            return self._sub_next
+
+    def _on_create_batch_response(self, sender: str, p: dict) -> None:
+        """Fold a delegated sub-batch's response into the original batch."""
+        with self._lock:
+            self._sub_batches.pop(p.get("rid"), None)
+            hook = self._sub_done.pop(p.get("rid"), None)
+        if hook is None:
+            return
+        hook(p.get("results") or {})
+
     # ------------------------------------------------------------ name delete
     def _on_delete(self, sender: str, p: dict) -> None:
         pkt.register_client(self.m.nodemap, p)
+        sender = p.get("reply_to") or sender
         name, rid = p["name"], p["rid"]
+        if not self._ensure_owner(name, sender, p):
+            return
 
         def committed(result: dict) -> None:
             if not result.get("ok"):
@@ -354,7 +535,24 @@ class Reconfigurator:
     # -------------------------------------------------------- actives lookup
     def _on_request_actives(self, sender: str, p: dict) -> None:
         pkt.register_client(self.m.nodemap, p)
+        sender = p.get("reply_to") or sender
         name, rid = p["name"], p["rid"]
+        if name != pkt.ALL_ACTIVES and not self._ensure_owner(name, sender, p):
+            return
+        if name == pkt.ALL_ACTIVES:
+            # anycast pool resolution: the whole active set, no record
+            # (ReconfigurableAppClientAsync.ALL_ACTIVES)
+            addrs = {}
+            for a in self.actives_pool:
+                addr = self.m.nodemap(a)
+                if addr is not None:
+                    addrs[a] = [addr[0], addr[1]]
+            self.m.send(sender, {
+                "type": pkt.ACTIVES_RESPONSE, "rid": rid, "name": name,
+                "ok": True, "epoch": -1, "actives": list(self.actives_pool),
+                "addrs": addrs,
+            })
+            return
         rec = self.db.get(name)
         if rec is None or rec.state == RCState.WAIT_DELETE:
             self.m.send(sender, {
@@ -395,7 +593,10 @@ class Reconfigurator:
 
     def _on_client_reconfigure(self, sender: str, p: dict) -> None:
         pkt.register_client(self.m.nodemap, p)
+        sender = p.get("reply_to") or sender
         name, rid = p["name"], p["rid"]
+        if not self._ensure_owner(name, sender, p):
+            return
         requested = p.get("new_actives") or []
         bad = [a for a in requested if a not in self.actives_pool]
         if not requested or bad:
@@ -601,6 +802,100 @@ class Reconfigurator:
                 self._reconfigure(name, new)
         return remaining
 
+    # -------------------------------------------------- RC-node elasticity
+    def _on_rc_node_config(self, sender: str, p: dict) -> None:
+        """handleReconfigureRCNodeConfig (Reconfigurator.java:1044), RC
+        side: splice a reconfigurator in/out of the pool.  The change
+        commits on the all-RC ``_NC_RC`` record; every RC then updates its
+        ring deterministically from the commit stream and re-homes records
+        whose consistent-hash group changed (``RCMigrateTask``)."""
+        pkt.register_client(self.m.nodemap, p)
+        sender = p.get("reply_to") or sender
+        node, rid = p.get("node"), p.get("rid")
+
+        def reject(error: str) -> None:
+            self.m.send(sender, {
+                "type": pkt.NODE_CONFIG_RESPONSE, "rid": rid, "ok": False,
+                "error": error,
+            })
+
+        if not node:
+            reject("need node")
+            return
+        removing = p["type"] == pkt.REMOVE_RC
+        pool = set(self.rdb.rc_ids)
+        if removing and node not in pool:
+            reject("unknown_node")
+            return
+        if removing and len(pool) - 1 < self.rdb.k:
+            reject("pool_too_small")
+            return
+        if not removing and p.get("addr"):
+            # learn the newcomer's address before the commit fans out
+            self.m.nodemap.add(node, p["addr"][0], int(p["addr"][1]))
+        cmd = {"op": "remove_rc" if removing else "add_rc",
+               "name": NC_RC_RECORD, "node": node, "addr": p.get("addr"),
+               "seed_pool": sorted(pool), "min_pool": self.rdb.k}
+
+        def committed(result: dict) -> None:
+            self.m.send(sender, {
+                "type": pkt.NODE_CONFIG_RESPONSE, "rid": rid,
+                "ok": bool(result.get("ok")), "node": node,
+                "pool": result.get("pool"),
+            })
+
+        self.rdb.commit(NC_RC_RECORD, cmd, committed, proposer=self.node_id)
+
+    def _apply_rc_node_config(self, cmd: dict, record: Optional[dict]) -> None:
+        node = cmd["node"]
+        pool = sorted(record["actives"]) if record else self.rdb.rc_ids
+        if cmd["op"] == "add_rc":
+            addr = cmd.get("addr")
+            if addr:
+                self.m.nodemap.add(node, addr[0], int(addr[1]))
+            self.rdb.bind_rc(node)
+        # splice the shared ring once (several Reconfigurator listeners may
+        # share one rdb in-process; update_pool is idempotent)
+        if sorted(pool) != sorted(self.rdb.rc_ids):
+            self.rdb.update_pool(pool)
+        epoch = record["epoch"] if record else 0
+        self.executor.cancel(f"RCMigrate:{epoch}")
+        self.executor.schedule(RCMigrateTask(self, epoch))
+
+    def _rc_migrate_once(self) -> int:
+        """One re-home sweep: install every local record whose new RC group
+        this node primaries (or whose primary is down) into that group.
+        Returns how many installs were issued (0 = converged)."""
+        issued = 0
+        pool_key = tuple(self.rdb.rc_ids)
+        for name in self.db.names() + [NC_RECORD]:
+            rec = self.db.get(name)
+            if rec is None:
+                continue
+            # EVERY holder installs (no primary gate): after a splice the
+            # re-homed group's primary may be the fresh node, which holds
+            # nothing — only the old holders can carry the record over.
+            # Duplicates are cheap no-op commits, deduped per holder below.
+            key = (pool_key, name, rec.epoch)
+            if key in self._rc_migrated:
+                continue
+            self._rc_migrated.add(key)
+
+            def installed(result: dict, key=key) -> None:
+                if not result.get("ok"):
+                    self._rc_migrated.discard(key)  # retry next sweep
+
+            # re-commit into the (possibly new) group; the install is a
+            # no-op wherever an equal-or-newer record already exists
+            self.rdb.commit(
+                name,
+                {"op": "record_install", "name": name,
+                 "record": rec.to_dict()},
+                installed, proposer=self.node_id,
+            )
+            issued += 1
+        return issued
+
     # --------------------------------------------------------- commit events
     def _on_db_commit(self, cmd: dict, record: Optional[dict]) -> None:
         """Listener on this node's DB replica: non-primary RC-group members
@@ -611,6 +906,10 @@ class Reconfigurator:
         if name == NC_RECORD:
             if cmd.get("op") in ("add_active", "remove_active"):
                 self._apply_node_config(cmd, record)
+            return
+        if name == NC_RC_RECORD:
+            if cmd.get("op") in ("add_rc", "remove_rc"):
+                self._apply_rc_node_config(cmd, record)
             return
         op = cmd.get("op")
         if op == "delete_complete":
@@ -630,6 +929,21 @@ class Reconfigurator:
             if in_group and self.rdb.primary_of(name) != self.node_id:
                 epoch = record["epoch"] if record else 0
                 self.executor.schedule(WaitPrimaryExecution(self, name, epoch))
+        elif op == "create_batch":
+            if cmd.get("origin") == self.node_id:
+                return
+            for c in cmd.get("creates", []):
+                n = c["name"]
+                if self.node_id not in self.rdb.rc_group_of(n):
+                    continue
+                t = WaitAckStartEpoch(
+                    self, n, 0, c["actives"], -1, [],
+                    pkt.b64d(c.get("initial_state")) or b"", None,
+                )
+                t.first_delayed = True
+                t.period_s = 2.0
+                self.executor.cancel(t.key)
+                self.executor.schedule(t)
         elif op == "create" and record is not None:
             if in_group and cmd.get("origin") != self.node_id:
                 # backup creation driver: if the origin RC dies before its

@@ -1,0 +1,235 @@
+"""Per-process deployment unit: the ``gpServer.sh`` / ``ReconfigurableNode``
+analog.
+
+The reference's unit of deployment is one process running the whole stack —
+transport, ActiveReplica and/or Reconfigurator, coordinator, logger — built
+by ``ReconfigurableNode.main``
+(``reconfiguration/ReconfigurableNode.java:63,259-336,434``, launched by
+``bin/gpServer.sh``).  :class:`ModeBServer` is that unit for the TPU
+framework: each process owns
+
+* a Messenger per role (actives and reconfigurators are distinct ids in the
+  topology, like ``active.*`` / ``reconfigurator.*`` lines);
+* an independent Mode B consensus node per plane, with its own WAL and
+  device state (``modeb/``), replica traffic as SoA frames over TCP;
+* the control-plane face for the role: :class:`ActiveReplica` over a
+  :class:`ModeBReplicaCoordinator`, and/or :class:`Reconfigurator` over a
+  :class:`ModeBRepliconfigurableDB`;
+* a keep-alive failure detector feeding the node's liveness mask every
+  tick (``FailureDetection.java:209-258`` → candidacy phase 0) — killing a
+  coordinator process needs no manual liveness control anywhere;
+* a TickDriver pumping each plane.
+
+Run from the CLI::
+
+    python -m gigapaxos_tpu.server --node AR0 --properties gigapaxos.properties \
+        --log-dir /var/lib/gptpu
+
+or embed (tests boot several in one process on loopback, the
+``TESTReconfigurationMain.startLocalServers`` strategy).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import threading
+from typing import Callable, Optional
+
+from .config import GigapaxosTpuConfig, load_properties
+from .models.replicable import KVApp, Replicable
+from .modeb import ModeBLogger, ModeBNode, recover_modeb
+from .modeb.coordinator import ModeBReplicaCoordinator, ModeBRepliconfigurableDB
+from .net.failure_detection import FailureDetection
+from .net.messenger import Messenger, NodeMap
+from .net.security import TransportSecurity
+from .paxos.driver import TickDriver
+from .reconfiguration.active_replica import ActiveReplica
+from .reconfiguration.demand import AbstractDemandProfile, DemandProfile
+from .reconfiguration.rc_db import ReconfiguratorDB
+from .reconfiguration.reconfigurator import Reconfigurator
+
+
+class ModeBServer:
+    """One OS process of a Mode B deployment (active and/or reconfigurator
+    role, depending on which topology section names ``node_id``)."""
+
+    def __init__(
+        self,
+        node_id: str,
+        cfg: GigapaxosTpuConfig,
+        app_factory: Callable[[], Replicable] = KVApp,
+        log_dir: Optional[str] = None,
+        start_fd: bool = True,
+        replicas_per_name: int = 3,
+        rc_group_size: int = 3,
+        demand_profile_factory: Callable[[str], AbstractDemandProfile] = DemandProfile,
+    ):
+        self.node_id = node_id
+        self.cfg = cfg
+        self.nodemap = NodeMap(cfg.nodes)
+        active_ids = cfg.nodes.active_ids()
+        rc_ids = cfg.nodes.reconfigurator_ids()
+        self.is_active = node_id in cfg.nodes.actives
+        self.is_rc = node_id in cfg.nodes.reconfigurators
+        if not (self.is_active or self.is_rc):
+            raise ValueError(f"{node_id!r} is in neither topology section")
+        log_dir = log_dir or cfg.log_dir
+        security = TransportSecurity.from_config(cfg.ssl)
+
+        self.fds: list = []
+        self.drivers: list = []
+        self.node: Optional[ModeBNode] = None
+        self.rc_node: Optional[ModeBNode] = None
+        self.active_replica: Optional[ActiveReplica] = None
+        self.reconfigurator: Optional[Reconfigurator] = None
+        self.app: Optional[Replicable] = None
+
+        if self.is_active:
+            bind = cfg.nodes.actives[node_id]
+            m = Messenger(node_id, bind, self.nodemap, security=security)
+            self.nodemap.add(node_id, bind[0], m.port)
+            cfg.nodes.actives[node_id] = (bind[0], m.port)
+            self.app = app_factory()
+            node, recovered = self._make_node(
+                active_ids, self.app,
+                os.path.join(log_dir, f"{node_id}-ar") if log_dir else None,
+            )
+            self.coordinator = ModeBReplicaCoordinator(node)
+            # ActiveReplica first: its BulkTransfer claims the raw-bytes
+            # handler, and the node's frame handler must chain OVER it
+            self.active_replica = ActiveReplica(
+                node_id, m, self.coordinator, rc_ids,
+                demand_profile_factory=demand_profile_factory,
+                rc_group_size=rc_group_size,
+            )
+            node.attach_messenger(m)
+            if recovered:
+                node.request_sync()
+            if start_fd:
+                fd = FailureDetection(
+                    m, monitored=active_ids,
+                    ping_interval_s=cfg.fd.ping_interval_s,
+                    timeout_s=cfg.fd.timeout_s,
+                )
+                node.attach_failure_detector(fd)
+                self.fds.append(fd)
+            self.node = node
+            self.drivers.append(self._start_driver(node))
+
+        if self.is_rc:
+            bind = cfg.nodes.reconfigurators[node_id]
+            m = Messenger(node_id, bind, self.nodemap, security=security)
+            self.nodemap.add(node_id, bind[0], m.port)
+            cfg.nodes.reconfigurators[node_id] = (bind[0], m.port)
+            db = ReconfiguratorDB(node_id)
+            rc_node, recovered = self._make_node(
+                rc_ids, db,
+                os.path.join(log_dir, f"{node_id}-rc") if log_dir else None,
+            )
+            self.rdb = ModeBRepliconfigurableDB(rc_node, rc_ids, k=rc_group_size)
+            fd = None
+            if start_fd:
+                fd = FailureDetection(
+                    m, monitored=rc_ids,
+                    ping_interval_s=cfg.fd.ping_interval_s,
+                    timeout_s=cfg.fd.timeout_s,
+                )
+                self.fds.append(fd)
+            self.reconfigurator = Reconfigurator(
+                node_id, m, self.rdb, active_ids,
+                replicas_per_name=replicas_per_name,
+                demand_profile_factory=demand_profile_factory,
+                is_node_up=fd.is_node_up if fd is not None else None,
+            )
+            rc_node.attach_messenger(m)
+            if recovered:
+                rc_node.request_sync()
+            if fd is not None:
+                rc_node.attach_failure_detector(fd)
+            self.rc_node = rc_node
+            self.drivers.append(self._start_driver(rc_node))
+
+    @staticmethod
+    def _start_driver(node: ModeBNode) -> TickDriver:
+        """Event-driven pumping: long idle sleep (several planes may share
+        few cores — an idle plane must not burn them), with work arrival
+        (propose / forwarded proposal / inbound frame) kicking the driver
+        awake immediately."""
+        driver = TickDriver(node, idle_sleep_s=0.05)
+        node.on_work = driver.kick
+        return driver.start()
+
+    def _make_node(self, member_ids, app, wal_dir):
+        """Build (or WAL-recover) one plane's ModeBNode, messenger-less —
+        the caller attaches the messenger after the control-plane endpoint
+        claims its handlers (3-pass recovery before live traffic,
+        PaxosManager.initiateRecovery, PaxosManager.java:1852)."""
+        if wal_dir and os.path.isdir(wal_dir) and os.listdir(wal_dir):
+            node = recover_modeb(
+                self.cfg, member_ids, self.node_id, app, wal_dir,
+                native=self.cfg.native_journal,
+            )
+            return node, True
+        wal = None
+        if wal_dir:
+            wal = ModeBLogger(wal_dir, native=self.cfg.native_journal)
+        node = ModeBNode(
+            self.cfg, member_ids, self.node_id, app, messenger=None, wal=wal
+        )
+        return node, False
+
+    # ------------------------------------------------------------------ admin
+    def wait_ready(self, timeout_s: float = 180.0) -> bool:
+        """Block until every plane's jitted tick compiled."""
+        return all(d.wait_ready(timeout_s) for d in self.drivers)
+
+    def close(self) -> None:
+        for fd in self.fds:
+            fd.close()
+        # drivers first: a tick sending frames after the messenger closed
+        # would die with SendFailure on the driver thread
+        for d in self.drivers:
+            d.stop()
+        if self.active_replica is not None:
+            self.active_replica.close()
+        if self.reconfigurator is not None:
+            self.reconfigurator.close()
+        for n in (self.node, self.rc_node):
+            if n is not None:
+                n.close()
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        description="gigapaxos_tpu per-process server (gpServer.sh analog)"
+    )
+    ap.add_argument("--node", required=True, help="node id from the topology")
+    ap.add_argument("--properties", required=True,
+                    help="gigapaxos.properties-style topology/config file")
+    ap.add_argument("--log-dir", default=None, help="WAL root directory")
+    ap.add_argument("--no-fd", action="store_true",
+                    help="disable the failure detector (tests only)")
+    args = ap.parse_args(argv)
+
+    cfg = load_properties(args.properties)
+    server = ModeBServer(
+        args.node, cfg, log_dir=args.log_dir, start_fd=not args.no_fd
+    )
+    server.wait_ready()
+    print(f"gigapaxos_tpu server {args.node} ready", flush=True)
+
+    stop = threading.Event()
+
+    def _sig(_signum, _frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    stop.wait()
+    server.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""One OS process of a Mode B deployment for the multi-process e2e test.
+
+Thin wrapper over :class:`gigapaxos_tpu.server.ModeBServer` (the
+``gpServer.sh`` analog): argv carries the node id and a JSON spec with the
+static topology (pre-assigned ports, as a properties file would have).
+Prints "ready" once every plane's jitted tick compiled; exits on stdin
+"exit"/EOF.  SIGKILL the process to emulate machine death; restart with the
+same log dir to exercise WAL recovery.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from gigapaxos_tpu.config import GigapaxosTpuConfig  # noqa: E402
+from gigapaxos_tpu.server import ModeBServer  # noqa: E402
+
+
+def main() -> None:
+    node_id = sys.argv[1]
+    spec = json.loads(sys.argv[2])
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = int(spec.get("max_groups", 32))
+    # gentle FD cadence: 7 processes share this box's core(s), and 50ms
+    # pings across 7x3 pairs are real CPU; detection latency ~2s is plenty
+    cfg.fd.ping_interval_s = float(spec.get("fd_ping", 0.2))
+    cfg.fd.timeout_s = float(spec.get("fd_timeout", 2.0))
+    for nid, (host, port) in spec["actives"].items():
+        cfg.nodes.actives[nid] = (host, int(port))
+    for nid, (host, port) in spec["rcs"].items():
+        cfg.nodes.reconfigurators[nid] = (host, int(port))
+
+    server = ModeBServer(
+        node_id, cfg,
+        log_dir=spec.get("log_dir"),
+        replicas_per_name=int(spec.get("replicas_per_name", 3)),
+    )
+    server.wait_ready(600)
+    print("ready", flush=True)
+    for line in sys.stdin:
+        cmd = line.strip()
+        if cmd == "exit":
+            break
+        if cmd == "stats":
+            out = {}
+            for tag, node in (("ar", server.node), ("rc", server.rc_node)):
+                if node is None:
+                    continue
+                out[tag] = {
+                    "alive": [bool(x) for x in node.alive],
+                    "ticks": node.tick_num,
+                    "stats": dict(node.stats),
+                    "coord_view": {
+                        name: int(node._coord_view[row])
+                        for name, row in node.rows.items()
+                    },
+                }
+            print("stats " + json.dumps(out), flush=True)
+    server.close()
+
+
+if __name__ == "__main__":
+    main()

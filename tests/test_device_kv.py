@@ -1,0 +1,164 @@
+"""Device-resident KV app: decisions execute on-device, fused with the tick.
+
+Reference workload app: gigapaxos/testing/TESTPaxosApp.java:60 (state
+updates driven by the decision stream).  Correctness is checked against a
+plain-python dict model over randomized op sequences.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from gigapaxos_tpu.models.device_kv import (
+    OP_DEL,
+    OP_GET,
+    OP_PUT,
+    DeviceKVApp,
+    fused_step_jit,
+    init_kv,
+    kv_apply,
+    register_requests,
+)
+from gigapaxos_tpu.ops.tick import TickInbox
+from gigapaxos_tpu.paxos import state as st
+
+R, G, W, S = 3, 4, 8, 8
+
+
+def make_exec(planned):
+    """planned: list of (r, g, [rids...]) -> (exec_req [R,W,G], exec_count)."""
+    req = np.zeros((R, W, G), np.int32)
+    cnt = np.zeros((R, G), np.int32)
+    for r, g, rids in planned:
+        for j, rid in enumerate(rids):
+            req[r, j, g] = rid
+        cnt[r, g] = len(rids)
+    return jnp.asarray(req), jnp.asarray(cnt)
+
+
+def test_put_get_del_semantics():
+    kv = init_kv(R, G, slots=S, table=1 << 10)
+    # rid: 1 PUT k5=77 | 2 GET k5 | 3 DEL k5 | 4 GET k5
+    kv = register_requests(
+        kv,
+        [1, 2, 3, 4],
+        [OP_PUT, OP_GET, OP_DEL, OP_GET],
+        [5, 5, 5, 5],
+        [77, 0, 0, 0],
+    )
+    req, cnt = make_exec([(r, 0, [1, 2, 3, 4]) for r in range(R)])
+    kv2, resp, miss = kv_apply(kv, req, cnt)
+    resp = np.asarray(resp)
+    for r in range(R):
+        assert resp[r, 0, 0] == 77  # PUT echoes value
+        assert resp[r, 1, 0] == 77  # GET sees the same-tick earlier PUT
+        assert resp[r, 2, 0] == 77  # DEL returns the old value
+        assert resp[r, 3, 0] == 0   # GET after DEL: absent
+    assert not np.asarray(miss).any()
+    # state persists across ticks: k5 deleted
+    kv3 = register_requests(kv2, [9], [OP_GET], [5], [0])
+    req2, cnt2 = make_exec([(0, 0, [9])])
+    _, resp2, _ = kv_apply(kv3, req2, cnt2)
+    assert np.asarray(resp2)[0, 0, 0] == 0
+
+
+def test_unregistered_rid_is_miss():
+    kv = init_kv(R, G, slots=S, table=1 << 10)
+    req, cnt = make_exec([(0, 1, [1234])])
+    kv2, resp, miss = kv_apply(kv, req, cnt)
+    assert bool(np.asarray(miss)[0, 0, 1])
+    assert np.asarray(resp)[0, 0, 1] == 0
+    # app state untouched
+    assert np.asarray(kv2.key).sum() == 0
+
+
+def test_randomized_against_dict_model():
+    rng = np.random.default_rng(3)
+    kv = init_kv(1, 1, slots=S, table=1 << 12)
+    model = {}
+    next_rid = 1
+    for _tick in range(20):
+        n = int(rng.integers(1, W + 1))
+        rids, ops, keys, vals = [], [], [], []
+        for _ in range(n):
+            rids.append(next_rid)
+            next_rid += 1
+            ops.append(int(rng.choice([OP_PUT, OP_GET, OP_DEL])))
+            # keys within one cache-slot-collision-free set: the
+            # direct-mapped store evicts colliding keys, the dict does not
+            keys.append(int(rng.integers(1, S + 1)))
+            vals.append(int(rng.integers(1, 1000)))
+        kv = register_requests(kv, rids, ops, keys, vals)
+        req, cnt = make_exec([(0, 0, rids)])
+        kv, resp, miss = kv_apply(kv, req, cnt)
+        resp = np.asarray(resp)[0]
+        assert not np.asarray(miss).any()
+        for j in range(n):
+            k, v, op = keys[j], vals[j], ops[j]
+            if op == OP_PUT:
+                expect = v
+                model[k] = v
+            elif op == OP_GET:
+                expect = model.get(k, 0)
+            else:
+                expect = model.pop(k, 0)
+            assert resp[j, 0] == expect, (j, op, k, v, model)
+
+
+def test_fused_step_consensus_to_device_execution():
+    """Requests flow: inbox -> consensus tick -> on-device execution, no
+    host round-trip; every replica's app state converges identically."""
+    state = st.create_groups(
+        st.init_state(R, G, W), np.arange(G, dtype=np.int32),
+        np.ones((G, R), bool),
+    )
+    kv = init_kv(R, G, slots=S, table=1 << 12)
+    kv = register_requests(
+        kv, [101, 102], [OP_PUT, OP_PUT], [3, 4], [31, 41]
+    )
+    req = np.zeros((R, 4, G), np.int32)
+    req[0, 0, 0] = 101
+    req[0, 1, 2] = 102
+    inbox = TickInbox(jnp.asarray(req),
+                      jnp.zeros((R, 4, G), jnp.bool_),
+                      jnp.ones((R,), jnp.bool_))
+    empty = TickInbox(jnp.zeros((R, 4, G), jnp.int32),
+                      jnp.zeros((R, 4, G), jnp.bool_),
+                      jnp.ones((R,), jnp.bool_))
+    executed = 0
+    for i in range(6):
+        state, kv, out, resp, miss = fused_step_jit(
+            state, kv, inbox if i == 0 else empty
+        )
+        executed += int(np.asarray(out.exec_count).sum())
+        assert not np.asarray(miss).any()
+    assert executed >= 2 * R  # both requests executed on every replica
+    keys = np.asarray(kv.key)
+    vals = np.asarray(kv.val)
+    for r in range(R):
+        assert vals[r, 0, 3 & (S - 1)] == 31 and keys[r, 0, 3 & (S - 1)] == 3
+        assert vals[r, 2, 4 & (S - 1)] == 41
+    # all replicas converged to identical app state
+    for r in range(1, R):
+        assert np.array_equal(keys[0], keys[r])
+        assert np.array_equal(vals[0], vals[r])
+
+
+def test_checkpoint_restore_roundtrip():
+    kv = init_kv(R, G, slots=S, table=1 << 10)
+    kv = register_requests(kv, [1, 2], [OP_PUT, OP_PUT], [3, 6], [30, 60])
+    req, cnt = make_exec([(0, 1, [1, 2])])
+    kv, _, _ = kv_apply(kv, req, cnt)
+    app = DeviceKVApp(kv, replica=0, row_of=lambda name: 1)
+    blob = app.checkpoint("svc")
+    assert blob
+    # wipe and restore
+    app.kv = app.kv._replace(
+        key=app.kv.key.at[0, 1].set(0), val=app.kv.val.at[0, 1].set(0)
+    )
+    app.restore("svc", blob)
+    assert int(app.kv.val[0, 1, 3 & (S - 1)]) == 30
+    assert int(app.kv.val[0, 1, 6 & (S - 1)]) == 60
+    with pytest.raises(NotImplementedError):
+        app.execute("svc", b"x", 1)

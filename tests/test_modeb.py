@@ -115,9 +115,20 @@ class Cluster:
         drain()
         # a frame already popped by the writer thread retries connecting for
         # up to ~3.2s before being dropped; wait it out so NOTHING from the
-        # backlog survives, then drain whatever queued meanwhile
-        time.sleep(4.0)
-        drain()
+        # backlog survives.  Under CPU contention the retry backoff can run
+        # longer, so keep draining until the queues stay empty for a while.
+        quiet = 0
+        for _ in range(12):
+            time.sleep(1.0)
+            before = sum(
+                other.m.transport._peers[nid].q.qsize()
+                for other in self.nodes.values()
+                if nid in other.m.transport._peers
+            )
+            drain()
+            quiet = quiet + 1 if before == 0 else 0
+            if quiet >= 2 and _ >= 4:
+                break
 
     def restart(self, nid):
         """Rebuild the node from its own WAL and rejoin."""

@@ -1,0 +1,173 @@
+"""Mode B safety valves under stress: link latency, mass-laggard rejoin,
+anti-entropy cost at scale.
+
+Round-2 verdict items: failover tests all ran at loopback RTT (the
+reference emulates WAN delays, ``nio/JSONDelayEmulator.java:39-77``); the
+mass-laggard path (a fresh node joining a busy cluster with many groups)
+was untested; anti-entropy traffic was unmeasured.  All three run here over
+the deterministic ``SimNet``.
+"""
+
+import sys
+
+import numpy as np
+import pytest
+
+from gigapaxos_tpu.config import GigapaxosTpuConfig
+from gigapaxos_tpu.models.replicable import KVApp
+from gigapaxos_tpu.modeb import ModeBNode
+from gigapaxos_tpu.testing.simnet import SimNet
+
+IDS = ["N0", "N1", "N2"]
+
+
+def make_cfg(groups, window=8):
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = groups
+    cfg.paxos.window = window
+    return cfg
+
+
+class SimCluster:
+    def __init__(self, groups=16, anti_entropy_every=16, delay=0):
+        self.net = SimNet()
+        self.net.default_delay = delay
+        cfg = make_cfg(groups)
+        self.cfg = cfg
+        self.apps = {nid: KVApp() for nid in IDS}
+        self.nodes = {
+            nid: ModeBNode(cfg, IDS, nid, self.apps[nid],
+                           self.net.messenger(nid),
+                           anti_entropy_every=anti_entropy_every)
+            for nid in IDS
+        }
+
+    def create(self, name, only=None):
+        for nid, nd in self.nodes.items():
+            if only is None or nid in only:
+                nd.create_group(name, [0, 1, 2])
+
+    def spin(self, k, only=None):
+        for _ in range(k):
+            for nid, nd in self.nodes.items():
+                if only is None or nid in only:
+                    nd.tick()
+            self.net.pump()
+
+    def commit(self, at, name, payload, max_ticks=300, only=None):
+        done = []
+        rid = self.nodes[at].propose(name, payload,
+                                     lambda _r, x: done.append(x))
+        assert rid is not None
+        for _ in range(max_ticks):
+            self.spin(1, only=only)
+            if done:
+                return done[0]
+        raise AssertionError(f"no commit of {payload!r} at {at}")
+
+
+def test_commit_and_failover_under_link_delay():
+    """Every link carries 3 pump-rounds of latency (the JSONDelayEmulator
+    scenario): commits still land, and killing the coordinator still fails
+    over — correctness must not depend on loopback RTT."""
+    cl = SimCluster(delay=3)
+    cl.create("svc")
+    assert cl.commit("N1", "svc", b"PUT a 1") == b"OK"
+    # kill the coordinator (N0): endpoints close, survivors mark it dead
+    cl.nodes["N0"].close()
+    del cl.nodes["N0"]
+    for nd in cl.nodes.values():
+        nd.set_alive(0, False)
+    assert cl.commit("N1", "svc", b"PUT b 2",
+                     only=("N1", "N2"), max_ticks=400) == b"OK"
+    for _ in range(200):  # delayed links: give N2 time to learn the decision
+        if all(cl.apps[nid].db.get("svc", {}).get("b") == "2"
+               for nid in ("N1", "N2")):
+            break
+        cl.spin(1, only=("N1", "N2"))
+    for nid in ("N1", "N2"):
+        assert cl.apps[nid].db["svc"]["b"] == "2", nid
+
+
+@pytest.mark.slow
+def test_mass_laggard_fresh_node_converges():
+    """A FRESH node (empty state, no WAL) joins a busy cluster with many
+    groups: whois resolves the gids, anti-entropy full frames rebuild the
+    mirrors, and checkpoint transfers repair groups whose decisions are
+    long gone — until its app state matches the cluster's."""
+    G = 64
+    cl = SimCluster(groups=G + 8, anti_entropy_every=16)
+    names = [f"g{i}" for i in range(G)]
+    # only N0/N1 know the groups; N2 stays dark (the fresh joiner later)
+    for n in names:
+        cl.create(n, only=("N0", "N1"))
+    cl.nodes["N2"].close()
+    del cl.nodes["N2"]
+    for nd in cl.nodes.values():
+        nd.set_alive(2, False)
+    # busy cluster: several committed writes per group (more than W in some)
+    for i, n in enumerate(names):
+        assert cl.commit("N0", n, f"PUT k {i}".encode(),
+                         only=("N0", "N1")) == b"OK"
+    for n in names[:4]:  # push a few groups past the ring window
+        for j in range(10):
+            assert cl.commit("N0", n, f"PUT deep {j}".encode(),
+                             only=("N0", "N1")) == b"OK"
+    # fresh N2: brand-new state, no journal — joins and asks for sync
+    cl.apps["N2"] = KVApp()
+    cl.nodes["N2"] = ModeBNode(cl.cfg, IDS, "N2", cl.apps["N2"],
+                               cl.net.messenger("N2"),
+                               anti_entropy_every=16)
+    for nd in cl.nodes.values():
+        nd.set_alive(2, True)
+    cl.nodes["N2"].request_sync()
+    want_rows = len(names)
+    for round_ in range(4000):
+        cl.spin(1)
+        n2 = cl.nodes["N2"]
+        if (len(list(n2.rows.items())) >= want_rows
+                and all(cl.apps["N2"].db.get(n, {}).get("k") is not None
+                        for n in names)
+                and cl.apps["N2"].db.get("g0", {}).get("deep") == "9"):
+            break
+    else:
+        known = len(list(cl.nodes["N2"].rows.items()))
+        missing = [n for n in names
+                   if cl.apps["N2"].db.get(n, {}).get("k") is None]
+        raise AssertionError(
+            f"fresh node never converged: rows={known}/{want_rows}, "
+            f"missing={missing[:8]} stats={dict(cl.nodes['N2'].stats)}"
+        )
+    # and it serves traffic afterwards
+    assert cl.commit("N2", "g1", b"PUT post 1") == b"OK"
+
+
+def test_anti_entropy_cost_measured():
+    """Anti-entropy full frames re-ship every row periodically: measure the
+    actual frame bytes per tick at a few hundred groups so the cost is a
+    recorded number, not folklore (printed for the bench artifact)."""
+    G = 256
+    cl = SimCluster(groups=G, anti_entropy_every=32)
+    for i in range(G - 8):
+        cl.create(f"g{i}")
+    # one committed write in a slice of groups so rows are live
+    for i in range(0, G - 8, 32):
+        assert cl.commit("N0", f"g{i}", b"PUT a 1") == b"OK"
+    sent0 = cl.net.stats["sent"]
+    n0 = cl.nodes["N0"]
+    bytes0 = n0.stats.get("frame_bytes", 0)
+    t0 = n0.tick_num
+    cl.spin(96)  # 3 anti-entropy cycles, no load
+    dticks = n0.tick_num - t0
+    dbytes = n0.stats.get("frame_bytes", 0) - bytes0
+    per_tick = dbytes / max(dticks, 1)
+    print(f"\nanti-entropy: {per_tick:.0f} frame B/tick/node at "
+          f"{G - 8} groups (idle), {cl.net.stats['sent'] - sent0} msgs",
+          file=sys.stderr)
+    # sanity bound: idle anti-entropy must stay << full-state-per-tick
+    # (full frame every 32 ticks amortizes to ~rows/32 per tick)
+    assert dbytes > 0
+    full_frame_estimate = (G - 8) * 150  # ~150B/row on the wire
+    assert per_tick < full_frame_estimate, (
+        "anti-entropy is shipping ~full state EVERY tick"
+    )

@@ -1,0 +1,124 @@
+"""RC-node add/remove at runtime (ReconfigureRCNodeConfig analog,
+Reconfigurator.handleReconfigureRCNodeConfig, Reconfigurator.java:1044).
+
+Splice a reconfigurator into / out of the pool while names exist: the
+committed ``_NC_RC`` change re-hashes record ownership, records migrate to
+their re-homed RC groups via idempotent installs, and every name stays
+resolvable throughout — including through the freshly added RC and after
+removing a boot-time RC.
+"""
+
+import time
+
+import pytest
+
+from gigapaxos_tpu.client import ReconfigurableAppClient
+from gigapaxos_tpu.config import GigapaxosTpuConfig
+from gigapaxos_tpu.models.replicable import KVApp
+from gigapaxos_tpu.node import InProcessCluster
+from gigapaxos_tpu.reconfiguration.rc_db import NC_RC_RECORD
+
+
+def make_cfg(n_active=3, n_rc=3):
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 64
+    for i in range(n_active):
+        cfg.nodes.actives[f"AR{i}"] = ("127.0.0.1", 0)
+    for i in range(n_rc):
+        cfg.nodes.reconfigurators[f"RC{i}"] = ("127.0.0.1", 0)
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    cl = InProcessCluster(make_cfg(), KVApp, rc_group_size=2,
+                          spare_rc_slots=1)
+    yield cl
+    cl.close()
+
+
+@pytest.fixture(scope="module")
+def client(cluster):
+    c = ReconfigurableAppClient(cluster.cfg.nodes)
+    yield c
+    c.close()
+
+
+NAMES = [f"rcsvc{i}" for i in range(6)]
+
+
+def _all_resolvable(client, names, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    left = list(names)
+    while left and time.monotonic() < deadline:
+        n = left[0]
+        try:
+            if client.request_actives(n, force=True):
+                left.pop(0)
+                continue
+        except Exception:
+            pass
+        time.sleep(0.3)
+    return not left
+
+
+def test_add_rc_node(cluster, client):
+    for n in NAMES:
+        assert client.create(n)["ok"]
+        assert client.request(n, b"PUT k v") == b"OK"
+    # start the new RC endpoint first (the process must exist before the
+    # committed NC-RC change routes traffic to it), then the admin splice
+    cluster.add_rc_endpoint("RC3")
+    host, port = cluster.cfg.nodes.reconfigurators["RC3"]
+    resp = client.add_reconfigurator("RC3", host, port)
+    assert resp["ok"], resp
+    assert "RC3" in resp["pool"]
+    # ring re-hash propagated: every RC (incl. RC3) now shares the ring
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if cluster.rdb.rc_ids == ["RC0", "RC1", "RC2", "RC3"]:
+            break
+        time.sleep(0.2)
+    assert cluster.rdb.rc_ids == ["RC0", "RC1", "RC2", "RC3"]
+    # names stay resolvable while records migrate, and new creates work
+    assert _all_resolvable(client, NAMES)
+    assert client.create("post-add")["ok"]
+    assert client.request("post-add", b"PUT a 1") == b"OK"
+    # some name is now owned by a group containing RC3, and RC3's DB learns
+    # its records via the migration installs
+    moved = [n for n in NAMES + ["post-add"]
+             if "RC3" in cluster.rdb.rc_group_of(n)]
+    if moved:
+        rc3 = cluster.reconfigurators["RC3"]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(rc3.db.get(n) is not None for n in moved):
+                break
+            time.sleep(0.3)
+        missing = [n for n in moved if rc3.db.get(n) is None]
+        assert not missing, f"records never migrated to RC3: {missing}"
+
+
+def test_remove_rc_node(cluster, client):
+    """Remove a boot-time RC: records it primaried re-home; names stay
+    resolvable through the remaining pool."""
+    resp = client.remove_reconfigurator("RC0")
+    assert resp["ok"], resp
+    assert "RC0" not in resp["pool"]
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if "RC0" not in cluster.rdb.rc_ids:
+            break
+        time.sleep(0.2)
+    assert cluster.rdb.rc_ids == ["RC1", "RC2", "RC3"]
+    # give migration a moment, then tear down the endpoint
+    time.sleep(2.0)
+    cluster.remove_rc_endpoint("RC0")
+    assert _all_resolvable(client, NAMES + ["post-add"], timeout=60)
+    # full lifecycle still works on the new pool
+    assert client.create("post-remove")["ok"]
+    assert client.request("post-remove", b"PUT z 9") == b"OK"
+    assert client.delete("post-remove")["ok"]
+    # the NC-RC record reflects the final pool on a surviving replica
+    rec = cluster.reconfigurators["RC1"].db.get(NC_RC_RECORD)
+    assert rec is not None and rec.actives == ["RC1", "RC2", "RC3"]

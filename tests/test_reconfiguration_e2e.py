@@ -133,6 +133,36 @@ def test_demand_driven_migration(cluster, client):
     assert client.request("hot", b"GET k1") == b"1"
 
 
+def test_batched_creates(cluster, client):
+    """One RC commit per create batch per RC group
+    (BatchedCreateServiceName.java; TESTReconfigurationClient.java:676-1002
+    exercises batched creates the same way)."""
+    names = [f"batch{i}" for i in range(8)]
+    resp = client.create_batch(names)
+    assert resp["ok"], resp
+    assert set(resp["results"]) == set(names)
+    for n in names[:3]:
+        assert client.request(n, b"PUT x 1") == b"OK"
+        assert len(client.request_actives(n)) == 3
+    # duplicate batch -> per-name exists errors, nothing re-created
+    dup = client.create_batch(names[:2])
+    assert not dup["ok"]
+    assert all(r.get("error") == "exists" for r in dup["results"].values())
+
+
+def test_anycast_request(cluster, client):
+    """Anycast: the client never resolves the name's replica set — any
+    active accepts the request and a non-hosting one forwards it to a
+    hosting replica, which answers the client directly
+    (sendRequestAnycast, ReconfigurableAppClientAsync.java:1357)."""
+    assert client.create("anyc")["ok"]
+    assert client.request("anyc", b"PUT k val") == b"OK"
+    # 5 actives, 3 replicas: repeated anycasts hit non-members too, so the
+    # forward path is exercised with high probability
+    for _ in range(6):
+        assert client.request_anycast("anyc", b"GET k") == b"val"
+
+
 def test_echo_rtt(cluster, client):
     a = client.request_actives("svc0")[0]
     rtt = client.echo(a)

@@ -1,4 +1,5 @@
 from .coordinator import ChainReplicaCoordinator
 from .manager import ChainManager
+from .modeb import ChainModeBNode
 
-__all__ = ["ChainManager", "ChainReplicaCoordinator"]
+__all__ = ["ChainManager", "ChainModeBNode", "ChainReplicaCoordinator"]

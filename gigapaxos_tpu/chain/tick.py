@@ -68,7 +68,14 @@ class ChainOutbox(NamedTuple):
     committed_now: jnp.ndarray  # int32 [G]
 
 
-def chain_tick_impl(state, inbox: ChainInbox):
+def chain_tick_impl(state, inbox: ChainInbox, own_row: int = -1):
+    """own_row: -1 for Mode A (whole chain in one device program).  In
+    chain Mode B (one process per chain node, ``chain/modeb.py``) peer rows
+    are frame-fed mirrors and only the own row may transition: intake is
+    confined to the own row when it is the head (a mirror of the head must
+    not simulate ordering), while forward-copy and apply read only
+    *mirror facts* (the predecessor really holds those slots; its applied
+    prefix is immutable because slots are ordered once by the head)."""
     R, G = state.applied.shape
     W = state.c_req.shape[1]
     P = inbox.req.shape[0]
@@ -107,6 +114,13 @@ def chain_tick_impl(state, inbox: ChainInbox):
 
     is_head = (r_idx == head[None, :]) & member  # [R, G]
     head_alive = jnp.any(is_head & alive[:, None], axis=0)  # [G]
+    if own_row >= 0:
+        # Mode B: only the own row may perform head intake; whether the
+        # group is open for intake HERE additionally requires that we ARE
+        # the head (the manager forwards to the head process otherwise)
+        own2 = r_idx == own_row
+        is_head = is_head & own2
+        head_alive = head_alive & jnp.any(is_head, axis=0)
     head_active = sel_r(state.status, head) == int(GroupStatus.ACTIVE)
 
     # ---------------- head intake: order new writes ----------------

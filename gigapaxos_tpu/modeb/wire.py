@@ -117,22 +117,29 @@ def encode_frame(
     payloads: List[Tuple[int, bool, bytes]],
     full: bool = False,
     digest: np.ndarray = None,
+    scalar_fields: Tuple[str, ...] = SCALARS,
+    ring_fields: Tuple[str, ...] = RINGS,
+    bit_fields: Tuple[str, ...] = RING_BITS,
+    magic: bytes = MAGIC,
 ) -> bytes:
+    """The field lists parameterize the schema so other per-group protocols
+    (chain replication, ``chain/modeb.py``) reuse the same SoA codec with
+    their own columns under a distinct magic."""
     n = len(gids)
     parts = [
-        _HDR.pack(MAGIC, VERSION, W, sender_r, tick, int(full), n,
+        _HDR.pack(magic, VERSION, W, sender_r, tick, int(full), n,
                   len(payloads)),
         np.ascontiguousarray(gids, dtype=np.uint64).tobytes(),
     ]
-    for f in SCALARS:
+    for f in scalar_fields:
         parts.append(np.ascontiguousarray(scalars[f], np.int32).tobytes())
     parts.append(np.ascontiguousarray(flags, np.int32).tobytes())
     if digest is None:
         digest = np.zeros(n, np.int32)
     parts.append(np.ascontiguousarray(digest, np.int32).tobytes())
-    for f in RINGS:
+    for f in ring_fields:
         parts.append(np.ascontiguousarray(rings[f], np.int32).tobytes())
-    for f in RING_BITS:
+    for f in bit_fields:
         parts.append(pack_bits(ring_bits[f]).tobytes())
     for rid, stop, data in payloads:
         parts.append(_PAY.pack(rid, int(stop), len(data)))
@@ -140,9 +147,15 @@ def encode_frame(
     return b"".join(parts)
 
 
-def decode_frame(buf: bytes) -> Frame:
-    magic, ver, W, sender_r, tick, full, n, n_pay = _HDR.unpack_from(buf, 0)
-    if magic != MAGIC or ver != VERSION:
+def decode_frame(
+    buf: bytes,
+    scalar_fields: Tuple[str, ...] = SCALARS,
+    ring_fields: Tuple[str, ...] = RINGS,
+    bit_fields: Tuple[str, ...] = RING_BITS,
+    magic: bytes = MAGIC,
+) -> Frame:
+    hmagic, ver, W, sender_r, tick, full, n, n_pay = _HDR.unpack_from(buf, 0)
+    if hmagic != magic or ver != VERSION:
         raise ValueError("bad replica frame header")
     off = _HDR.size
 
@@ -154,11 +167,11 @@ def decode_frame(buf: bytes) -> Frame:
         return a
 
     gids = col(np.uint64, n)
-    scalars = {f: col(np.int32, n) for f in SCALARS}
+    scalars = {f: col(np.int32, n) for f in scalar_fields}
     flags = col(np.int32, n)
     digest = col(np.int32, n)
-    rings = {f: col(np.int32, n * W).reshape(n, W) for f in RINGS}
-    ring_bits = {f: unpack_bits(col(np.int32, n), W) for f in RING_BITS}
+    rings = {f: col(np.int32, n * W).reshape(n, W) for f in ring_fields}
+    ring_bits = {f: unpack_bits(col(np.int32, n), W) for f in bit_fields}
     payloads: List[Tuple[int, bool, bytes]] = []
     for _ in range(n_pay):
         rid, stop, ln = _PAY.unpack_from(buf, off)

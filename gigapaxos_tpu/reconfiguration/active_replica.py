@@ -269,7 +269,10 @@ class ActiveReplica:
             while len(self._any_pending) > 1024:
                 self._any_pending.popitem(last=False)
         rcs = self.rc_ring.replicated_servers(name, self.rc_k)
-        self.m.send(rcs[0], pkt.request_active_replicas(name, qrid))
+        # random member: a dead fixed target must not blackhole every retry
+        import random as _random
+
+        self.m.send(_random.choice(rcs), pkt.request_active_replicas(name, qrid))
 
     def _on_actives_response(self, sender: str, p: dict) -> None:
         with self._any_lock:
@@ -286,7 +289,11 @@ class ActiveReplica:
         for a, addr in (p.get("addrs") or {}).items():
             if self.m.nodemap(a) is None:
                 self.m.nodemap.add(a, addr[0], int(addr[1]))
-        target = p["actives"][0]
+        import random as _random
+
+        # random hosting replica: client retries then spread across the
+        # group instead of deterministically re-hitting a dead first member
+        target = _random.choice(p["actives"])
         req["reply_to"] = reply_to
         req["fwd"] = 1
         self.m.send(target, req)

@@ -368,7 +368,10 @@ class Reconfigurator:
         ReconfigurableAppClientAsync.java:35)."""
         pkt.register_client(self.m.nodemap, p)
         sender = p.get("reply_to") or sender
-        rid, creates = p["rid"], p.get("creates", [])
+        rid = p["rid"]
+        # dedup by name: results are keyed by name, so duplicates would make
+        # the completion count unreachable and strand the response
+        creates = list({c["name"]: c for c in p.get("creates", [])}.values())
         if not creates:
             self.m.send(sender, {"type": pkt.CREATE_BATCH_RESPONSE,
                                  "rid": rid, "ok": False,
@@ -879,11 +882,13 @@ class Reconfigurator:
             key = (pool_key, name, rec.epoch)
             if key in self._rc_migrated:
                 continue
-            self._rc_migrated.add(key)
 
+            # confirm-on-success only: a lost proposal (no callback) keeps
+            # the key unmarked, so the next sweep re-issues the idempotent
+            # install instead of silently abandoning the record
             def installed(result: dict, key=key) -> None:
-                if not result.get("ok"):
-                    self._rc_migrated.discard(key)  # retry next sweep
+                if result.get("ok"):
+                    self._rc_migrated.add(key)
 
             # re-commit into the (possibly new) group; the install is a
             # no-op wherever an equal-or-newer record already exists

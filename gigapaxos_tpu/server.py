@@ -65,7 +65,12 @@ class ModeBServer:
         replicas_per_name: int = 3,
         rc_group_size: int = 3,
         demand_profile_factory: Callable[[str], AbstractDemandProfile] = DemandProfile,
+        coordinator: str = "paxos",
     ):
+        """``coordinator``: "paxos" (ModeBNode data plane, WAL-backed) or
+        "chain" (ChainModeBNode — cross-host chain replication; rejoins
+        from peers, no local WAL yet).  Mirrors REPLICA_COORDINATOR_CLASS
+        (ReconfigurableNode.java:203-218)."""
         self.node_id = node_id
         self.cfg = cfg
         self.nodemap = NodeMap(cfg.nodes)
@@ -92,10 +97,18 @@ class ModeBServer:
             self.nodemap.add(node_id, bind[0], m.port)
             cfg.nodes.actives[node_id] = (bind[0], m.port)
             self.app = app_factory()
-            node, recovered = self._make_node(
-                active_ids, self.app,
-                os.path.join(log_dir, f"{node_id}-ar") if log_dir else None,
-            )
+            if coordinator == "chain":
+                from .chain.modeb import ChainModeBNode
+
+                node = ChainModeBNode(cfg, active_ids, node_id, self.app)
+                recovered = False
+            elif coordinator == "paxos":
+                node, recovered = self._make_node(
+                    active_ids, self.app,
+                    os.path.join(log_dir, f"{node_id}-ar") if log_dir else None,
+                )
+            else:
+                raise ValueError(f"unknown coordinator {coordinator!r}")
             self.coordinator = ModeBReplicaCoordinator(node)
             # ActiveReplica first: its BulkTransfer claims the raw-bytes
             # handler, and the node's frame handler must chain OVER it

@@ -45,6 +45,11 @@ class PaxosTuning:
     # Deactivation: spill groups idle for this many ticks to host (pause
     # analog, PaxosManager.java:2284-2365).
     deactivation_ticks: int = 10_000
+    # Demand-paged pause store (DiskMap analog, utils/DiskMap.java:97):
+    # paused-group records beyond spill_cache page to spill_dir ("" = RAM
+    # only — the paused set is then bounded by host memory).
+    spill_dir: str = ""
+    spill_cache: int = 4096
 
     def __post_init__(self) -> None:
         if self.window < 2 or (self.window & (self.window - 1)):
@@ -108,6 +113,9 @@ class GigapaxosTpuConfig:
     nodes: NodeConfig = field(default_factory=NodeConfig)
     # WAL directory; None = in-memory only (tests).
     log_dir: str | None = None
+    # Periodic stats dumps via logging (0 = off; PaxosManager.java:482-494
+    # outstanding-dump analog).  Flat properties key: stats_interval_s=10
+    stats_interval_s: float = 0.0
     # Use the C++ journal backend when available.
     native_journal: bool = True
 

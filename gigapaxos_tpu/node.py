@@ -87,7 +87,8 @@ class InProcessCluster:
             self.manager = ChainManager(cfg, n_slots, apps, wal=wal)
             self.coordinator = ChainReplicaCoordinator(self.manager, active_ids)
         elif coordinator == "paxos":
-            self.manager = PaxosManager(cfg, n_slots, apps, wal=wal)
+            self.manager = PaxosManager(cfg, n_slots, apps, wal=wal,
+                                        spill_ns="ar")
             self.coordinator = PaxosReplicaCoordinator(self.manager, active_ids)
         else:
             raise ValueError(f"unknown coordinator {coordinator!r}")
@@ -99,7 +100,8 @@ class InProcessCluster:
         rc_apps = [ReconfiguratorDB(r) for r in rc_ids] + [
             ReconfiguratorDB(f"_spare{i}") for i in range(spare_rc_slots)
         ]
-        self.rc_manager = PaxosManager(cfg, len(rc_apps), rc_apps, wal=rc_wal)
+        self.rc_manager = PaxosManager(cfg, len(rc_apps), rc_apps, wal=rc_wal,
+                                       spill_ns="rc")
         self.rdb = RepliconfigurableReconfiguratorDB(
             self.rc_manager, rc_ids, k=rc_group_size
         )

@@ -62,7 +62,11 @@ class PaxosManager:
         n_replicas: int,
         apps: List[Replicable],
         wal=None,
+        spill_ns: str = "default",
     ):
+        """``spill_ns`` namespaces this manager's disk spill store — several
+        managers (data plane + RC plane) share one cfg, and their DiskMaps
+        must never adopt or clear each other's cold files."""
         assert len(apps) == n_replicas
         self.cfg = cfg
         self.R = n_replicas
@@ -92,8 +96,18 @@ class PaxosManager:
         self.stats = collections.Counter()
         self._stopped_rows: set[int] = set()
         # ---- pause/spill (deactivation, PaxosManager.java:2284-2412) ----
-        # name -> HotRestoreInfo dict (+ "stopped" flag); device row freed
-        self._paused: Dict[str, dict] = {}
+        # name -> HotRestoreInfo dict (+ "stopped" flag); device row freed.
+        # With spill_dir set, cold paused records demand-page to disk
+        # (DiskMap analog) so the paused population can exceed host RAM.
+        import os as _os
+
+        from ..utils.diskmap import DiskMap
+
+        self._paused = DiskMap(
+            _os.path.join(cfg.paxos.spill_dir, spill_ns)
+            if cfg.paxos.spill_dir else None,
+            cfg.paxos.spill_cache,
+        )
         self._last_active = np.zeros(self.G, np.int64)
         self._row_outstanding = collections.Counter()
         # Control-plane threads (messenger readers, protocol tasks) call the

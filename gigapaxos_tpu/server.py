@@ -85,6 +85,11 @@ class ModeBServer:
 
         self.fds: list = []
         self.drivers: list = []
+        self.reporter = None
+        if cfg.stats_interval_s > 0:
+            from .utils.observability import StatsReporter
+
+            self.reporter = StatsReporter(node_id, cfg.stats_interval_s)
         self.node: Optional[ModeBNode] = None
         self.rc_node: Optional[ModeBNode] = None
         self.active_replica: Optional[ActiveReplica] = None
@@ -130,6 +135,14 @@ class ModeBServer:
                 self.fds.append(fd)
             self.node = node
             self.drivers.append(self._start_driver(node))
+            if self.reporter is not None:
+                from .utils.observability import (node_stats_source,
+                                                  transport_stats_source)
+
+                self.reporter.add_source("ar", node_stats_source(node))
+                self.reporter.add_source(
+                    "ar_net", transport_stats_source(m.transport)
+                )
 
         if self.is_rc:
             bind = cfg.nodes.reconfigurators[node_id]
@@ -163,6 +176,17 @@ class ModeBServer:
                 rc_node.attach_failure_detector(fd)
             self.rc_node = rc_node
             self.drivers.append(self._start_driver(rc_node))
+            if self.reporter is not None:
+                from .utils.observability import (node_stats_source,
+                                                  transport_stats_source)
+
+                self.reporter.add_source("rc", node_stats_source(rc_node))
+                self.reporter.add_source(
+                    "rc_net", transport_stats_source(m.transport)
+                )
+
+        if self.reporter is not None:
+            self.reporter.start()
 
     @staticmethod
     def _start_driver(node: ModeBNode) -> TickDriver:
@@ -199,6 +223,8 @@ class ModeBServer:
         return all(d.wait_ready(timeout_s) for d in self.drivers)
 
     def close(self) -> None:
+        if self.reporter is not None:
+            self.reporter.stop()
         for fd in self.fds:
             fd.close()
         # drivers first: a tick sending frames after the messenger closed

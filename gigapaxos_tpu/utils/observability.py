@@ -1,0 +1,94 @@
+"""Periodic state dumps: the observability loop.
+
+The reference logs periodic summaries — DelayProfiler stats printed from
+the execution loop (``PaxosInstanceStateMachine.java:1794-1796``) and the
+outstanding/unpaused counts dump (``PaxosManager.java:482-494``).
+:class:`StatsReporter` is that loop for the TPU framework: registered
+sources are polled on an interval and emitted as one JSON line each through
+``logging`` (machine-parseable, journald/file friendly).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Callable, Dict
+
+log = logging.getLogger("gigapaxos_tpu.stats")
+
+
+class StatsReporter:
+    def __init__(self, node_id: str, interval_s: float = 10.0):
+        self.node_id = node_id
+        self.interval_s = max(interval_s, 0.5)
+        self._sources: Dict[str, Callable[[], dict]] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def add_source(self, tag: str, fn: Callable[[], dict]) -> None:
+        with self._lock:
+            self._sources[tag] = fn
+
+    def snapshot(self) -> dict:
+        """One dump of every source (the periodic line's payload)."""
+        out = {"node": self.node_id, "ts": time.time()}
+        with self._lock:
+            sources = dict(self._sources)
+        for tag, fn in sources.items():
+            try:
+                out[tag] = fn()
+            except Exception as e:  # a broken source must not kill the loop
+                out[tag] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        return out
+
+    def start(self) -> "StatsReporter":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name=f"stats-{self.node_id}", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            log.info("%s", json.dumps(self.snapshot(), default=str))
+
+
+def node_stats_source(node) -> Callable[[], dict]:
+    """Standard source for a ModeBNode / ChainModeBNode."""
+
+    import contextlib
+
+    def snap() -> dict:
+        # the reporter thread races the tick thread on these structures:
+        # take the node lock (when it has one) so dict copies don't hit
+        # "changed size during iteration" under load
+        lock = getattr(node, "lock", None)
+        with (lock if lock is not None else contextlib.nullcontext()):
+            return {
+                "ticks": node.tick_num,
+                "alive": [bool(x) for x in node.alive],
+                "groups": len(list(node.rows.items())),
+                "outstanding": len(node.outstanding),
+                "stats": dict(node.stats),
+            }
+
+    return snap
+
+
+def transport_stats_source(transport) -> Callable[[], dict]:
+    """Byte/message counters (NIOInstrumenter analog,
+    nio/nioutils/NIOInstrumenter.java)."""
+
+    def snap() -> dict:
+        return dict(transport.stats)
+
+    return snap

@@ -167,8 +167,9 @@ class PaxosLogger:
             "queues": {row: list(q) for row, q in m._queues.items() if q},
             # paused groups live only in the spill store + host app state:
             # a snapshot that dropped them would lose them forever once the
-            # journal holding their OP_CREATE is GC'd
-            "paused": dict(getattr(m, "_paused", {})),
+            # journal holding their OP_CREATE is GC'd.  peek() keeps cold
+            # entries on disk instead of rewriting the whole cold tier.
+            "paused": self._paused_snapshot(m),
             "apps": [
                 {
                     name: m.apps[i].checkpoint(name)
@@ -178,6 +179,14 @@ class PaxosLogger:
                 for i in range(m.R)
             ],
         }
+
+    @staticmethod
+    def _paused_snapshot(m) -> dict:
+        paused = getattr(m, "_paused", {})
+        peek = getattr(paused, "peek", None)
+        if peek is None:
+            return dict(paused)
+        return {k: peek(k) for k in list(paused)}
 
     def checkpoint(self) -> str:
         """Write a full snapshot and roll the journal; GC superseded files."""
@@ -283,7 +292,8 @@ def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
                 m.tick_num = tick_num + 1
 
 
-def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True):
+def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
+            spill_ns: str = "default"):
     """Rebuild a PaxosManager from disk: snapshot + deterministic tick replay
     (the analog of the reference's 3-pass recovery,
     PaxosManager.java:1852-2055, where pass 2 re-drives logged messages
@@ -297,7 +307,11 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True):
     from .journal import read_journal
 
     logger = PaxosLogger(log_dir, native=native)
-    m = PaxosManager(cfg, n_replicas, apps)
+    m = PaxosManager(cfg, n_replicas, apps, spill_ns=spill_ns)
+    # stale pre-crash spill files must never pre-populate the pause store:
+    # they would make OP_CREATE replay return False and desync the row
+    # allocation from the original run (snapshot/journal are the authority)
+    m._paused.clear()
     snap_seq = logger._latest_snapshot_seq()
     start_seq = 0
     if snap_seq is not None:
@@ -320,7 +334,9 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True):
             m.outstanding[rid] = rec
         for row, rids in meta["queues"].items():
             m._queues[int(row)] = collections.deque(rids)
-        m._paused = dict(meta.get("paused", {}))
+        # repopulate (not replace) the pause store — cleared above, before
+        # either the snapshot load or journal-only replay runs
+        m._paused.update(meta.get("paused", {}))
         # derived bookkeeping the snapshot does not carry directly
         m._row_outstanding = collections.Counter(
             rec.row for rec in m.outstanding.values()

@@ -21,9 +21,11 @@ flavor of the Mode B design (``modeb/``):
 * a laggard (or fresh) node repairs by checkpoint transfer from an
   up-to-date peer, exactly like the paxos Mode B node.
 
-Durability: chain Mode B nodes currently rejoin from peers (fresh state +
-whois + anti-entropy + checkpoint transfer) rather than a local WAL — the
-Mode A chain plane owns the journaled deployment shape (``wal/chain_logger``).
+Durability: each node owns an independent journal+snapshot WAL
+(``chain/modeb_logger.py``, the chain flavor of ``modeb/logger.py``) —
+SIGKILL a node, restart with the same log dir, and it replays its own
+journal then rejoins via ``request_sync()``; peers repair any remaining gap
+by ring copy or checkpoint transfer.
 
 Known debt: the host plumbing (payload store + routed dedup, whois, frame
 staging/flush, sweeps, callback flushing) mirrors ``modeb/manager.py``;
@@ -162,6 +164,7 @@ class ChainModeBNode:
         node_id: str,
         app: Replicable,
         messenger: Optional[Messenger] = None,
+        wal=None,
         anti_entropy_every: int = 64,
     ):
         self.cfg = cfg
@@ -213,6 +216,9 @@ class ChainModeBNode:
         #: whois-birth gate (see ModeBNode.whois_birth): epoch groups must
         #: be born by StartEpoch with seeded state, not whois self-healing
         self.whois_birth: Optional[Callable[[str], bool]] = None
+        self.wal = wal
+        if wal is not None:
+            wal.attach(self)
         if messenger is not None:
             self.attach_messenger(messenger)
 
@@ -262,6 +268,8 @@ class ChainModeBNode:
             self._row_meta[row] = (name, list(members), epoch)
             self._stopped_rows.discard(row)
             self._dirty[row] = True
+            if self.wal is not None:
+                self.wal.log_create(name, list(members), epoch)
             return True
 
     def remove_group(self, name: str) -> bool:
@@ -269,6 +277,8 @@ class ChainModeBNode:
             row = self.rows.row(name)
             if row is None:
                 return False
+            if self.wal is not None:
+                self.wal.log_remove(name)
             self.state = st.free_groups(self.state, np.array([row], np.int32))
             self.rows.free(name)
             self._gid_row.pop(wire.gid_of(name), None)
@@ -353,6 +363,18 @@ class ChainModeBNode:
     def propose_stop(self, name: str, payload: bytes = b"", callback=None):
         return self.propose(name, payload, callback, stop=True)
 
+    def bump_seq(self, rids) -> None:
+        """Advance the local rid sequence past any observed own-origin rids
+        (forwarded rids never enter the local journal — same regression
+        hole as the paxos flavor, modeb/manager.py bump_seq)."""
+        a = np.asarray(rids).ravel()
+        if a.size == 0:
+            return
+        mine = a[(a >> RID_SHIFT) == self.r]
+        if mine.size:
+            self._next_seq = max(self._next_seq,
+                                 int(mine.max() & RID_MASK) + 1)
+
     def _forward(self, rec: ChainBRecord, head: int) -> None:
         if self.m is None:
             self._queues[rec.row].append(rec.rid)
@@ -393,11 +415,15 @@ class ChainModeBNode:
                 self.alive = mask
             self._flush_mirrors()
             inbox = self._build_inbox()
+            if self.wal is not None:
+                self.wal.log_inbox(self.tick_num, inbox)
             self.state, out, changed = self._tick(self.state, inbox)
             self._process_outbox(out)
             self._dirty |= np.asarray(changed)
             self.tick_num += 1
             frame = self._build_frame()
+            if self.wal is not None:
+                self.wal.maybe_checkpoint()
             self._release_committed()
             self._flush_callbacks()
             if self.tick_num % 16 == 0 or self._tainted_rows:
@@ -527,6 +553,8 @@ class ChainModeBNode:
     def _flush_callbacks(self) -> None:
         if not self._held_callbacks:
             return
+        if self.wal is not None and not self.wal.is_synced():
+            return  # log-before-respond (AbstractPaxosLogger.java:157-178)
         held, self._held_callbacks = self._held_callbacks, []
         for cb, rid, resp in held:
             cb(rid, resp)
@@ -602,6 +630,8 @@ class ChainModeBNode:
             self.stats["bad_frames"] += 1
             return
         with self.lock:
+            if self.wal is not None:
+                self.wal.log_frame(payload)
             self._stage_frame(frame, sender)
         self._wake()
 
@@ -615,10 +645,12 @@ class ChainModeBNode:
         self._frame_applied_tick[sr] = frame.tick
         self._last_frame_rx = self.tick_num
         for rid, stop, data in frame.payloads:
+            self.bump_seq(np.array([rid]))
             if rid not in self.outstanding and rid not in self.payloads:
                 self.payloads[rid] = (data, stop)
                 while len(self.payloads) > self._payload_cap:
                     self.payloads.popitem(last=False)
+        self.bump_seq(frame.rings["c_req"])
         n = len(frame.gids)
         if n == 0:
             return
@@ -764,6 +796,15 @@ class ChainModeBNode:
             row = self._gid_row.get(gid)
             if row is None:
                 return
+            if self.wal is not None:
+                self.wal.log_ckpt(gid, p)
+            self._apply_ckpt(row, p)
+        self._wake()
+
+    def _apply_ckpt(self, row: int, p: dict) -> None:
+        """Adopt a donor checkpoint (shared with WAL replay — the transfer
+        mutates own-row state outside the deterministic tick)."""
+        with self.lock:
             donor_applied = int(p["applied"])
             have = int(self.state.applied[self.r, row])
             if donor_applied < have or (donor_applied == have

@@ -1,0 +1,178 @@
+"""Per-node WAL + recovery for chain Mode B.
+
+Same shape as the paxos flavor (``modeb/logger.py``): the chain node step is
+deterministic given (state, staged frames, placed intake, alive mask), so
+the journal records exactly those inputs in arrival order and recovery is
+snapshot + in-order replay through the same jitted kernel, followed by
+``request_sync()`` to refresh mirrors from live peers.
+"""
+
+from __future__ import annotations
+
+import glob
+import io
+import os
+import pickle
+
+import numpy as np
+
+from ..modeb.logger import ModeBLogger, OP_CKPT, OP_FRAME
+from ..wal.logger import OP_CREATE, OP_REMOVE, OP_TICK
+
+
+class ChainBLogger(ModeBLogger):
+    """Only the snapshot metadata differs from the paxos flavor — frame/
+    ckpt/intake journaling (including the fsync group-commit policy) is
+    inherited so durability fixes live in ONE place.  ModeBLogger's
+    ``log_inbox`` already reads the shared ``_placed``/``outstanding``/
+    ``payloads`` shapes both node flavors expose."""
+
+    def _meta(self, m) -> dict:
+        return {
+            "tick_num": m.tick_num,
+            "next_seq": m._next_seq,
+            "rows": dict(m.rows.items()),
+            "free_rows": list(m.rows._free),
+            "row_meta": dict(m._row_meta),
+            "stopped_rows": set(m._stopped_rows),
+            "tainted_rows": set(m._tainted_rows),
+            "payloads": list(m.payloads.items()),
+            "outstanding": [
+                (r.rid, r.name, r.row, r.payload, r.stop, r.responded,
+                 r.born_tick)
+                for r in m.outstanding.values()
+            ],
+            "queues": {row: list(q) for row, q in m._queues.items() if q},
+            "frame_applied": dict(m._frame_applied_tick),
+            "app": {name: m.app.checkpoint(name) for name in m.rows.names()},
+        }
+
+
+def recover_chain_modeb(cfg, member_ids, node_id, app, log_dir: str,
+                        native: bool = True):
+    """Rebuild a ChainModeBNode from its own disk; attach a messenger and
+    call ``request_sync()`` afterwards to rejoin the chain set."""
+    import collections
+
+    import jax.numpy as jnp
+
+    from ..modeb import wire
+    from ..wal.journal import read_journal
+    from .modeb import (CH_BITS, CH_MAGIC, CH_RINGS, CH_SCALARS,
+                        ChainBRecord, ChainModeBNode, RID_MASK, RID_SHIFT)
+    from .state import ChainState
+    from .tick import ChainInbox
+
+    logger = ChainBLogger(log_dir, native=native)
+    node = ChainModeBNode(cfg, member_ids, node_id, app)
+    snap_seq = logger._latest_snapshot_seq()
+    start_seq = 0
+    if snap_seq is not None:
+        with open(logger._snapshot_path(snap_seq), "rb") as f:
+            meta, npz_blob = pickle.loads(f.read())
+        arrs = np.load(io.BytesIO(npz_blob))
+        node.state = ChainState(
+            **{f: jnp.asarray(arrs[f]) for f in ChainState._fields}
+        )
+        node.tick_num = meta["tick_num"]
+        node._next_seq = meta["next_seq"]
+        node.rows.restore(meta["rows"], meta["free_rows"])
+        node._gid_row = {wire.gid_of(n): row for n, row in meta["rows"].items()}
+        node._row_meta = dict(meta["row_meta"])
+        node._stopped_rows = set(meta["stopped_rows"])
+        node._tainted_rows = set(meta.get("tainted_rows", ()))
+        for rid, pl in meta["payloads"]:
+            node.payloads[rid] = pl
+        for rid, name, row, payload, stop, responded, born in meta[
+            "outstanding"
+        ]:
+            rec = ChainBRecord(rid, name, row, payload, stop, None, born)
+            rec.responded = responded
+            node.outstanding[rid] = rec
+        for row, rids in meta["queues"].items():
+            node._queues[int(row)] = collections.deque(rids)
+        node._frame_applied_tick = dict(meta["frame_applied"])
+        for name, blob in meta["app"].items():
+            node.app.restore(name, blob)
+        start_seq = snap_seq
+
+    for path in sorted(glob.glob(os.path.join(log_dir, "journal.*.log"))):
+        seq = int(os.path.basename(path).split(".")[1])
+        if seq < start_seq:
+            continue
+        for raw in read_journal(path):
+            rec = pickle.loads(raw)
+            op = rec[0]
+            if op == OP_CREATE:
+                _, name, members, epoch = rec
+                if name not in node.rows:
+                    node.create_group(name, members, epoch)
+            elif op == OP_REMOVE:
+                node.remove_group(rec[1])
+            elif op == OP_FRAME:
+                try:
+                    node._stage_frame(wire.decode_frame(
+                        rec[1], scalar_fields=CH_SCALARS,
+                        ring_fields=CH_RINGS, bit_fields=CH_BITS,
+                        magic=CH_MAGIC,
+                    ))
+                except (ValueError, IndexError):
+                    pass  # tolerate a frame torn by the crash
+            elif op == OP_CKPT:
+                _, gid, packet = rec
+                row = node._gid_row.get(gid)
+                if row is not None:
+                    node._apply_ckpt(row, packet)
+            elif op == OP_TICK:
+                _, tick_num, placed, alive_b = rec
+                if tick_num < node.tick_num:
+                    continue  # already inside the snapshot
+                req = np.zeros((node.P, node.G), np.int32)
+                stp = np.zeros((node.P, node.G), bool)
+                node._placed = []
+                for row, entries in placed:
+                    take = []
+                    placed_rids = set()
+                    for rid, p, payload, stop in entries:
+                        if (rid >> RID_SHIFT) == node.r:
+                            node._next_seq = max(
+                                node._next_seq, (rid & RID_MASK) + 1
+                            )
+                        placed_rids.add(rid)
+                        if (rid not in node.outstanding
+                                and rid not in node.payloads):
+                            node.payloads[rid] = (payload, stop)
+                        req[p, row] = rid
+                        stp[p, row] = stop
+                        take.append((rid, p))
+                    node._placed.append((row, take))
+                    if row in node._queues and placed_rids:
+                        node._queues[row] = collections.deque(
+                            r for r in node._queues[row]
+                            if r not in placed_rids
+                        )
+                node._flush_mirrors()
+                inbox = ChainInbox(
+                    jnp.asarray(req), jnp.asarray(stp),
+                    jnp.asarray(np.frombuffer(alive_b, dtype=bool)),
+                )
+                node.state, out, changed = node._tick(node.state, inbox)
+                node._process_outbox(out)
+                node._dirty |= np.asarray(changed)
+                node.tick_num = tick_num + 1
+
+    node._flush_mirrors()
+    node._held_callbacks = []  # no live clients to answer during replay
+    node._await_commit = []  # their clients are gone too; peers re-ack
+    # close the rid-regression hole: any rid that could ever commit is
+    # visible in some ring or payload/outstanding table (a rid forwarded to
+    # the head never enters the local journal as intake)
+    node.bump_seq(np.asarray(node.state.c_req))
+    node.bump_seq(np.fromiter(node.payloads.keys(), np.int64,
+                              len(node.payloads)))
+    node.bump_seq(np.fromiter(node.outstanding.keys(), np.int64,
+                              len(node.outstanding)))
+    logger.attach(node)
+    node.wal = logger
+    node._force_full = True
+    return node

@@ -67,10 +67,10 @@ class ModeBServer:
         demand_profile_factory: Callable[[str], AbstractDemandProfile] = DemandProfile,
         coordinator: str = "paxos",
     ):
-        """``coordinator``: "paxos" (ModeBNode data plane, WAL-backed) or
-        "chain" (ChainModeBNode — cross-host chain replication; rejoins
-        from peers, no local WAL yet).  Mirrors REPLICA_COORDINATOR_CLASS
-        (ReconfigurableNode.java:203-218)."""
+        """``coordinator``: "paxos" (ModeBNode data plane) or "chain"
+        (ChainModeBNode — cross-host chain replication); both WAL-backed
+        when ``log_dir`` is set, recovering from their own journals.
+        Mirrors REPLICA_COORDINATOR_CLASS (ReconfigurableNode.java:203-218)."""
         self.node_id = node_id
         self.cfg = cfg
         self.nodemap = NodeMap(cfg.nodes)
@@ -104,9 +104,22 @@ class ModeBServer:
             self.app = app_factory()
             if coordinator == "chain":
                 from .chain.modeb import ChainModeBNode
+                from .chain.modeb_logger import ChainBLogger, recover_chain_modeb
 
-                node = ChainModeBNode(cfg, active_ids, node_id, self.app)
-                recovered = False
+                wal_dir = (os.path.join(log_dir, f"{node_id}-chain")
+                           if log_dir else None)
+                if wal_dir and os.path.isdir(wal_dir) and os.listdir(wal_dir):
+                    node = recover_chain_modeb(
+                        cfg, active_ids, node_id, self.app, wal_dir,
+                        native=cfg.native_journal,
+                    )
+                    recovered = True
+                else:
+                    wal = (ChainBLogger(wal_dir, native=cfg.native_journal)
+                           if wal_dir else None)
+                    node = ChainModeBNode(cfg, active_ids, node_id, self.app,
+                                          wal=wal)
+                    recovered = False
             elif coordinator == "paxos":
                 node, recovered = self._make_node(
                     active_ids, self.app,

@@ -200,6 +200,82 @@ def test_chain_modeb_control_plane():
             s.close()
 
 
+def test_chain_kill_restart_from_own_journal(tmp_path):
+    """SIGKILL-equivalent: a chain node dies, restarts from ITS OWN journal
+    (nothing shared but TCP), recovers pre-crash state locally and catches
+    up on what it missed (the chain flavor of the Mode B recovery story)."""
+    from gigapaxos_tpu.chain.modeb_logger import ChainBLogger, recover_chain_modeb
+
+    cfg = make_cfg()
+    nodemap = NodeMap()
+    msgs = {}
+    for nid in IDS:
+        m = Messenger(nid, ("127.0.0.1", 0), nodemap)
+        nodemap.add(nid, "127.0.0.1", m.port)
+        msgs[nid] = m
+    apps = {nid: KVApp() for nid in IDS}
+    nodes = {
+        nid: ChainModeBNode(
+            cfg, IDS, nid, apps[nid], msgs[nid],
+            wal=ChainBLogger(str(tmp_path / nid), native=False),
+            anti_entropy_every=16,
+        )
+        for nid in IDS
+    }
+
+    def ticks(k, only=None):
+        for _ in range(k):
+            for nid, n in nodes.items():
+                if only is None or nid in only:
+                    n.tick()
+            time.sleep(0.004)
+
+    def commit(at, payload, only=None):
+        done = []
+        assert nodes[at].propose("svc", payload,
+                                 lambda _r, x: done.append(x)) is not None
+        for _ in range(300):
+            ticks(1, only=only)
+            if done:
+                return done[0]
+        raise AssertionError(f"no commit {payload!r}")
+
+    try:
+        for n in nodes.values():
+            n.create_group("svc", [0, 1, 2])
+        assert commit("C1", b"PUT k1 v1") == b"OK"
+        ticks(10)
+        db_c1 = dict(apps["C1"].db)
+        # kill the middle node (C1): survivors re-link and keep committing
+        nodes["C1"].close()
+        del nodes["C1"]
+        for n in nodes.values():
+            n.set_alive(1, False)
+        assert commit("C0", b"PUT k2 v2", only=("C0", "C2")) == b"OK"
+        # restart C1 from ITS OWN journal: pre-crash state must be back
+        apps["C1"] = KVApp()
+        n1 = recover_chain_modeb(cfg, IDS, "C1", apps["C1"],
+                                 str(tmp_path / "C1"), native=False)
+        assert apps["C1"].db == db_c1  # recovered locally, not copied
+        m = Messenger("C1", ("127.0.0.1", 0), nodemap)
+        nodemap.add("C1", "127.0.0.1", m.port)
+        n1.attach_messenger(m)
+        n1.request_sync()
+        nodes["C1"] = n1
+        for n in nodes.values():
+            n.set_alive(1, True)
+        for _ in range(300):
+            ticks(1)
+            if apps["C1"].db.get("svc", {}).get("k2") == "v2":
+                break
+        assert apps["C1"].db["svc"] == {"k1": "v1", "k2": "v2"}
+        # the rejoined node serves new traffic
+        assert commit("C1", b"PUT k3 v3") == b"OK"
+    finally:
+        for n in nodes.values():
+            n.close()
+
+
 def test_chain_stop_fences(cluster):
     cluster.create("svc")
     assert cluster.commit("C0", "svc", b"PUT a 1") == b"OK"

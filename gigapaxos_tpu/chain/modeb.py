@@ -27,9 +27,9 @@ SIGKILL a node, restart with the same log dir, and it replays its own
 journal then rejoins via ``request_sync()``; peers repair any remaining gap
 by ring copy or checkpoint transfer.
 
-Known debt: the host plumbing (payload store + routed dedup, whois, frame
-staging/flush, sweeps, callback flushing) mirrors ``modeb/manager.py``;
-a shared base for both protocol nodes would keep future fixes in one place.
+Shared host plumbing (rid space, payload/routed stores, FD refresh, staged
+row purge, log-before-respond callback flushing) lives in
+``modeb/common.ModeBCommon`` — fixes there cover both protocol flavors.
 """
 
 from __future__ import annotations
@@ -47,6 +47,7 @@ import numpy as np
 from ..config import GigapaxosTpuConfig
 from ..models.replicable import Replicable
 from ..modeb import wire
+from ..modeb.common import RID_MASK, RID_SHIFT, ModeBCommon  # noqa: F401
 from ..net.messenger import Messenger
 from ..net.transport import SendFailure
 from ..types import GroupStatus, NO_REQUEST
@@ -66,8 +67,6 @@ CH_WHOIS_REPLY = "chb_whois_reply"
 CH_CKPT_REQ = "chb_ckpt_req"
 CH_CKPT = "chb_ckpt"
 
-RID_SHIFT = 24
-RID_MASK = (1 << RID_SHIFT) - 1
 
 
 def chain_node_tick_impl(state, inbox: ChainInbox, r: int):
@@ -152,7 +151,7 @@ class ChainBRecord:
         self.born_tick = born_tick
 
 
-class ChainModeBNode:
+class ChainModeBNode(ModeBCommon):
     """One process of a multi-host chain deployment (ChainManager-per-
     machine analog).  Public surface mirrors :class:`ModeBNode` so drivers
     and coordinators bind either protocol."""
@@ -185,21 +184,13 @@ class ChainModeBNode:
         self._row_meta: Dict[int, tuple] = {}
         self.alive = np.ones(self.R, bool)
         self.tick_num = 0
-        self._next_seq = 1
+        self._init_common()  # rid space, payload/_routed stores, wake, FD
         self.outstanding: Dict[int, ChainBRecord] = {}
-        self.payloads: "collections.OrderedDict[int, tuple]" = (
-            collections.OrderedDict()
-        )
-        self._payload_cap = 1 << 16
-        self._routed: "collections.OrderedDict[int, bool]" = (
-            collections.OrderedDict()
-        )
         self._queues: Dict[int, collections.deque] = collections.defaultdict(
             collections.deque
         )
         self._stopped_rows: set = set()
         self._tainted_rows: set = set()
-        self._held_callbacks: list = []
         self._await_commit: list = []  # records applied locally, commit TBD
         self._dirty = np.zeros(self.G, bool)
         self._force_full = True
@@ -211,11 +202,6 @@ class ChainModeBNode:
         self.stats = collections.Counter()
         self.lock = threading.RLock()
         self._tick = chain_node_tick(self.r)
-        self._fd = None
-        self.on_work: Optional[Callable[[], None]] = None
-        #: whois-birth gate (see ModeBNode.whois_birth): epoch groups must
-        #: be born by StartEpoch with seeded state, not whois self-healing
-        self.whois_birth: Optional[Callable[[str], bool]] = None
         self.wal = wal
         if wal is not None:
             wal.attach(self)
@@ -240,15 +226,6 @@ class ChainModeBNode:
         self.m.register(CH_WHOIS_REPLY, self._on_whois_reply)
         self.m.register(CH_CKPT_REQ, self._on_ckpt_req)
         self.m.register(CH_CKPT, self._on_ckpt)
-
-    def attach_failure_detector(self, fd) -> None:
-        self._fd = fd
-        for nid in self.members:
-            fd.monitor(nid)
-
-    def _wake(self) -> None:
-        if self.on_work is not None:
-            self.on_work()
 
     # ------------------------------------------------------------------ admin
     def create_group(self, name: str, members: List[int],
@@ -285,19 +262,8 @@ class ChainModeBNode:
             self._row_meta.pop(row, None)
             self._queues.pop(row, None)
             self._stopped_rows.discard(row)
-            if self._pending_mirror:
-                pend = []
-                for sr, rows, keep, frame in self._pending_mirror:
-                    sel = rows != row
-                    if sel.all():
-                        pend.append((sr, rows, keep, frame))
-                    elif sel.any():
-                        pend.append((sr, rows[sel], keep[sel], frame))
-                self._pending_mirror = pend
+            self._purge_staged_row(row)
             return True
-
-    def set_alive(self, r: int, up: bool) -> None:
-        self.alive[r] = up
 
     def is_stopped(self, name: str) -> bool:
         row = self.rows.row(name)
@@ -345,10 +311,7 @@ class ChainModeBNode:
                 if callback is not None:
                     self._held_callbacks.append((callback, -1, None))
                 return None
-            if self._next_seq >= RID_MASK:
-                raise RuntimeError(f"{self.node_id}: rid space exhausted")
-            rid = (self.r << RID_SHIFT) | self._next_seq
-            self._next_seq += 1
+            rid = self.next_rid()
             rec = ChainBRecord(rid, name, row, payload, stop, callback,
                                self.tick_num)
             self.outstanding[rid] = rec
@@ -362,18 +325,6 @@ class ChainModeBNode:
 
     def propose_stop(self, name: str, payload: bytes = b"", callback=None):
         return self.propose(name, payload, callback, stop=True)
-
-    def bump_seq(self, rids) -> None:
-        """Advance the local rid sequence past any observed own-origin rids
-        (forwarded rids never enter the local journal — same regression
-        hole as the paxos flavor, modeb/manager.py bump_seq)."""
-        a = np.asarray(rids).ravel()
-        if a.size == 0:
-            return
-        mine = a[(a >> RID_SHIFT) == self.r]
-        if mine.size:
-            self._next_seq = max(self._next_seq,
-                                 int(mine.max() & RID_MASK) + 1)
 
     def _forward(self, rec: ChainBRecord, head: int) -> None:
         if self.m is None:
@@ -394,25 +345,19 @@ class ChainModeBNode:
             if row is None:
                 self._whois(gid, sender)
                 return
-            if rid in self.outstanding or rid in self._routed:
+            if rid in self.outstanding:
                 return
-            self.payloads[rid] = (bytes.fromhex(p["payload"]),
-                                  bool(p.get("stop")))
-            while len(self.payloads) > self._payload_cap:
-                self.payloads.popitem(last=False)
-            self._routed[rid] = True
-            while len(self._routed) > self._payload_cap:
-                self._routed.popitem(last=False)
+            self._store_payload(rid, bytes.fromhex(p["payload"]),
+                                bool(p.get("stop")))
+            if not self._mark_routed(rid):
+                return
             self._queues[row].append(rid)
         self._wake()
 
     # ------------------------------------------------------------------- tick
     def tick(self):
         with self.lock:
-            if self._fd is not None:
-                mask = self._fd.alive_mask(self.members)
-                mask[self.r] = True
-                self.alive = mask
+            self._refresh_alive()
             self._flush_mirrors()
             inbox = self._build_inbox()
             if self.wal is not None:
@@ -550,15 +495,6 @@ class ChainModeBNode:
                 still.append(rec)
         self._await_commit = still
 
-    def _flush_callbacks(self) -> None:
-        if not self._held_callbacks:
-            return
-        if self.wal is not None and not self.wal.is_synced():
-            return  # log-before-respond (AbstractPaxosLogger.java:157-178)
-        held, self._held_callbacks = self._held_callbacks, []
-        for cb, rid, resp in held:
-            cb(rid, resp)
-
     def _sweep(self) -> None:
         gone = [rid for rid, rec in self.outstanding.items()
                 if rec.responded and self.tick_num - rec.born_tick > 4096]
@@ -647,9 +583,7 @@ class ChainModeBNode:
         for rid, stop, data in frame.payloads:
             self.bump_seq(np.array([rid]))
             if rid not in self.outstanding and rid not in self.payloads:
-                self.payloads[rid] = (data, stop)
-                while len(self.payloads) > self._payload_cap:
-                    self.payloads.popitem(last=False)
+                self._store_payload(rid, data, stop)
         self.bump_seq(frame.rings["c_req"])
         n = len(frame.gids)
         if n == 0:

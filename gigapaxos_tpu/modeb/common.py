@@ -1,0 +1,141 @@
+"""Shared host plumbing for per-process consensus nodes (paxos + chain).
+
+Both Mode B node flavors (``modeb/manager.py``, ``chain/modeb.py``) carry
+the same subtle host-side machinery around their protocol kernels; fixes to
+any of these must land in ONE place:
+
+* the rid space (origin-tagged 24-bit sequences) and its regression guard;
+* the bounded payload store and forwarded-rid dedup (``_routed``);
+* the work-arrival wake hook for event-driven tick drivers;
+* failure-detector attachment feeding the per-tick alive mask;
+* the whois-birth gate (control-plane epoch groups must be born seeded);
+* purging staged mirror frames when a group row is freed;
+* log-before-respond callback flushing.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Optional
+
+import numpy as np
+
+RID_SHIFT = 24
+RID_MASK = (1 << RID_SHIFT) - 1
+
+
+def rid_origin(rid: int) -> int:
+    return rid >> RID_SHIFT
+
+
+class ModeBCommon:
+    """Mixin: expects the concrete node to define ``r``, ``members``,
+    ``alive``, ``lock``, ``stats``, ``wal``, ``_pending_mirror``, and the
+    collections initialized by :meth:`_init_common`."""
+
+    def _init_common(self) -> None:
+        self._next_seq = 1
+        self.payloads: "collections.OrderedDict[int, tuple]" = (
+            collections.OrderedDict()
+        )
+        self._payload_cap = 1 << 16
+        self._routed: "collections.OrderedDict[int, bool]" = (
+            collections.OrderedDict()
+        )
+        self._held_callbacks: list = []
+        self._fd = None
+        self.on_work: Optional[Callable[[], None]] = None
+        self.whois_birth: Optional[Callable[[str], bool]] = None
+
+    # ------------------------------------------------------------- rid space
+    def next_rid(self) -> int:
+        if self._next_seq >= RID_MASK:
+            # the sequence would bleed into the origin bits and corrupt rid
+            # routing — fail loudly instead of silently colliding
+            raise RuntimeError(
+                f"{self.node_id}: rid sequence space exhausted "
+                f"({self._next_seq} >= 2^{RID_SHIFT})"
+            )
+        rid = (self.r << RID_SHIFT) | self._next_seq
+        self._next_seq += 1
+        return rid
+
+    def bump_seq(self, rids) -> None:
+        """Advance the local rid sequence past any observed own-origin rids
+        (a rid forwarded to a remote never enters the local journal, so
+        after recovery the counter could regress and a fresh proposal would
+        collide with a committed rid)."""
+        a = np.asarray(rids).ravel()
+        if a.size == 0:
+            return
+        mine = a[(a >> RID_SHIFT) == self.r]
+        if mine.size:
+            self._next_seq = max(self._next_seq,
+                                 int(mine.max() & RID_MASK) + 1)
+
+    # --------------------------------------------------------- payload store
+    def _store_payload(self, rid: int, payload: bytes, stop: bool) -> None:
+        self.payloads[rid] = (payload, stop)
+        while len(self.payloads) > self._payload_cap:
+            self.payloads.popitem(last=False)
+
+    def _mark_routed(self, rid: int) -> bool:
+        """Record a forwarded rid; False if it was already routed here
+        (retransmission dedup at the same GC depth as the payload table)."""
+        if rid in self._routed:
+            return False
+        self._routed[rid] = True
+        while len(self._routed) > self._payload_cap:
+            self._routed.popitem(last=False)
+        return True
+
+    # ------------------------------------------------------------- liveness
+    def set_alive(self, r: int, up: bool) -> None:
+        self.alive[r] = up
+
+    def attach_failure_detector(self, fd) -> None:
+        """Feed the liveness mask from a keep-alive failure detector: every
+        tick re-derives ``alive`` from ``fd.alive_mask`` (own row always
+        up) — FailureDetection → candidacy/re-link wiring."""
+        self._fd = fd
+        for nid in self.members:
+            fd.monitor(nid)
+
+    def _refresh_alive(self) -> None:
+        if self._fd is not None:
+            mask = self._fd.alive_mask(self.members)
+            mask[self.r] = True
+            self.alive = mask
+
+    # ----------------------------------------------------------------- wake
+    def _wake(self) -> None:
+        if self.on_work is not None:
+            self.on_work()
+
+    # -------------------------------------------------------------- mirrors
+    def _purge_staged_row(self, row: int) -> None:
+        """Drop staged mirror-frame entries targeting a freed row: their row
+        indices were resolved at frame-arrival time, and a group recreated
+        into the recycled row must not inherit stale facts."""
+        if not self._pending_mirror:
+            return
+        pend = []
+        for sr, rows, keep, frame in self._pending_mirror:
+            sel = rows != row
+            if sel.all():
+                pend.append((sr, rows, keep, frame))
+            elif sel.any():
+                pend.append((sr, rows[sel], keep[sel], frame))
+        self._pending_mirror = pend
+
+    # ------------------------------------------------------------ callbacks
+    def _flush_callbacks(self) -> None:
+        """Release client responses only once the WAL covering their tick is
+        durable (log-before-respond, AbstractPaxosLogger.java:157-178)."""
+        if not self._held_callbacks:
+            return
+        if self.wal is not None and not self.wal.is_synced():
+            return
+        held, self._held_callbacks = self._held_callbacks, []
+        for cb, rid, resp in held:
+            cb(rid, resp)

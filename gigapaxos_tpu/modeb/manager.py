@@ -51,8 +51,7 @@ from .kernel import mirror_apply, node_tick
 #: request ids are node-scoped: high bits carry the origin replica slot so
 #: any node can route the response duty without a lookup (the entry-replica
 #: field of RequestPacket, gigapaxos/paxospackets/RequestPacket.java:189)
-RID_SHIFT = 24
-RID_MASK = (1 << RID_SHIFT) - 1
+from .common import RID_MASK, RID_SHIFT, ModeBCommon, rid_origin  # noqa: E402,F401
 
 MB_PROPOSAL = "mb_proposal"
 MB_WHOIS = "mb_whois"
@@ -60,10 +59,6 @@ MB_WHOIS_REPLY = "mb_whois_reply"
 MB_SYNC_REQ = "mb_sync_req"
 MB_CKPT_REQ = "mb_ckpt_req"
 MB_CKPT = "mb_ckpt"
-
-
-def rid_origin(rid: int) -> int:
-    return rid >> RID_SHIFT
 
 
 class ModeBRecord:
@@ -81,7 +76,7 @@ class ModeBRecord:
         self.born_tick = born_tick
 
 
-class ModeBNode:
+class ModeBNode(ModeBCommon):
     def __init__(
         self,
         cfg: GigapaxosTpuConfig,
@@ -111,17 +106,8 @@ class ModeBNode:
         self._row_meta: Dict[int, tuple] = {}  # row -> (name, members, epoch)
         self.alive = np.ones(self.R, bool)
         self.tick_num = 0
-        self._next_seq = 1
+        self._init_common()  # rid space, payload/_routed stores, wake, FD
         self.outstanding: Dict[int, ModeBRecord] = {}
-        #: rid -> (payload, stop) for requests originated elsewhere (bounded)
-        self.payloads: "collections.OrderedDict[int, tuple]" = (
-            collections.OrderedDict()
-        )
-        self._payload_cap = 1 << 16
-        #: rids ever queued from a forward (retransmit dedup, bounded)
-        self._routed: "collections.OrderedDict[int, bool]" = (
-            collections.OrderedDict()
-        )
         self._queues: Dict[int, collections.deque] = collections.defaultdict(
             collections.deque
         )
@@ -130,7 +116,6 @@ class ModeBNode:
         )
         self._seen_cap = 8 * self.W
         self._stopped_rows: set = set()
-        self._held_callbacks: list = []
         self._coord_view = np.full(self.G, -1, np.int32)
         self._dirty = np.zeros(self.G, bool)
         #: rows whose app state diverged by skipping a payload-less decision
@@ -148,18 +133,6 @@ class ModeBNode:
         self.stats = collections.Counter()
         self.lock = threading.RLock()
         self._tick = node_tick(self.r)
-
-        self._fd = None
-        #: work-arrival hook (TickDriver.kick): lets the driver sleep long
-        #: while idle — essential when many nodes share few cores — yet
-        #: react to proposals/frames at interactive latency
-        self.on_work: Optional[Callable[[], None]] = None
-        #: whois-birth gate: self-healing creation of unknown groups
-        #: (missed-birthing, PaxosManager.java:2459-2469) is wrong for
-        #: control-plane-managed epoch groups — their birth must carry the
-        #: previous epoch's final state, which only StartEpoch delivers.
-        #: The control-plane binding installs a filter; None = allow all.
-        self.whois_birth: Optional[Callable[[str], bool]] = None
 
         self.wal = wal
         if wal is not None:
@@ -227,36 +200,10 @@ class ModeBNode:
             self._row_meta.pop(row, None)
             self._queues.pop(row, None)
             self._stopped_rows.discard(row)
-            # purge staged mirror frames targeting the freed row: their row
-            # indices were resolved at frame-arrival time, and a group
-            # recreated into the recycled row must not inherit stale facts
-            if self._pending_mirror:
-                pend = []
-                for sr, rows, keep, frame in self._pending_mirror:
-                    sel = rows != row
-                    if sel.all():
-                        pend.append((sr, rows, keep, frame))
-                    elif sel.any():
-                        pend.append((sr, rows[sel], keep[sel], frame))
-                self._pending_mirror = pend
+            self._purge_staged_row(row)
             if _log and self.wal is not None:
                 self.wal.log_remove(name)
             return True
-
-    def set_alive(self, r: int, up: bool) -> None:
-        self.alive[r] = up
-
-    def attach_failure_detector(self, fd) -> None:
-        """Feed the liveness mask from a keep-alive failure detector: every
-        tick re-derives ``alive`` from ``fd.alive_mask`` (own row always up).
-        This is the reference's FailureDetection → checkRunForCoordinator
-        wiring (gigapaxos/FailureDetection.java:209-258 feeding
-        PaxosInstanceStateMachine.java:2070) — candidacy in the tick kernel
-        consults exactly this mask.  Replaces any manual ``set_alive``
-        control (which remains only as a harness hook)."""
-        self._fd = fd
-        for nid in self.members:
-            fd.monitor(nid)
 
     def is_stopped(self, name: str) -> bool:
         row = self.rows.row(name)
@@ -298,16 +245,7 @@ class ModeBNode:
                 if callback is not None:
                     self._held_callbacks.append((callback, -1, None))
                 return None
-            if self._next_seq >= RID_MASK:
-                # 2^24 own-origin proposals: the sequence would bleed into
-                # the origin bits and corrupt rid routing — fail loudly
-                # instead of silently colliding (advisor round 2)
-                raise RuntimeError(
-                    f"{self.node_id}: rid sequence space exhausted "
-                    f"({self._next_seq} >= 2^{RID_SHIFT})"
-                )
-            rid = (self.r << RID_SHIFT) | self._next_seq
-            self._next_seq += 1
+            rid = self.next_rid()
             rec = ModeBRecord(rid, name, row, payload, stop, callback,
                               self.tick_num)
             self.outstanding[rid] = rec
@@ -363,47 +301,16 @@ class ModeBNode:
             # queued for proposal, GC'd at the same depth as the payload
             # table (GCConcurrentHashMap of outstanding, PaxosManager.java:189).
             self._store_payload(rid, payload, stop)
-            if rid in self._routed:
+            if not self._mark_routed(rid):
                 return  # duplicate/late forward of a rid we already proposed
-            self._routed[rid] = True
-            while len(self._routed) > self._payload_cap:
-                self._routed.popitem(last=False)
             if rid not in self._queues[row]:
                 self._queues[row].append(rid)
         self._wake()
 
-    def _wake(self) -> None:
-        if self.on_work is not None:
-            self.on_work()
-
-    def _store_payload(self, rid: int, payload: bytes, stop: bool) -> None:
-        self.payloads[rid] = (payload, stop)
-        while len(self.payloads) > self._payload_cap:
-            self.payloads.popitem(last=False)
-
-    def bump_seq(self, rids) -> None:
-        """Advance the local rid sequence past any observed own-origin rids.
-
-        A rid forwarded to a remote coordinator never enters the local
-        journal, so after recovery the counter could regress and a fresh
-        proposal would collide with a committed rid — silently absorbed by
-        every dedup layer.  Any rid that could ever commit is visible in
-        some ring or payload table, so bumping on sight closes the hole."""
-        a = np.asarray(rids).ravel()
-        if a.size == 0:
-            return
-        mine = a[(a >> RID_SHIFT) == self.r]
-        if mine.size:
-            self._next_seq = max(self._next_seq,
-                                 int(mine.max() & RID_MASK) + 1)
-
     # ------------------------------------------------------------------- tick
     def tick(self):
         with self.lock:
-            if self._fd is not None:
-                mask = self._fd.alive_mask(self.members)
-                mask[self.r] = True
-                self.alive = mask
+            self._refresh_alive()
             self._flush_mirrors()
             inbox = self._build_inbox()
             if self.wal is not None:
@@ -539,15 +446,6 @@ class ModeBNode:
             rec.responded = True
             if rec.callback is not None:
                 self._held_callbacks.append((rec.callback, rid, response))
-
-    def _flush_callbacks(self) -> None:
-        if not self._held_callbacks:
-            return
-        if self.wal is not None and not self.wal.is_synced():
-            return
-        held, self._held_callbacks = self._held_callbacks, []
-        for cb, rid, resp in held:
-            cb(rid, resp)
 
     def _sweep(self) -> None:
         gone = []

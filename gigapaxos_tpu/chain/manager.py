@@ -32,7 +32,7 @@ from ..config import GigapaxosTpuConfig
 from ..models.replicable import Replicable
 from ..types import NO_REQUEST
 from ..utils.intmap import RowAllocator
-from ..utils.locking import locked as _locked
+from ..utils.locking import ContendedLock, locked as _locked
 from . import state as st
 from .tick import ChainInbox, ChainOutbox, chain_tick
 
@@ -77,7 +77,8 @@ class ChainManager:
         self._held_callbacks: list = []
         self.stats = collections.Counter()
         self._stopped_rows: set[int] = set()
-        self.lock = threading.RLock()
+        self.lock = ContendedLock()
+        self.lock_contended = self.lock.contended
         if self.wal is not None:
             self.wal.attach(self)
 

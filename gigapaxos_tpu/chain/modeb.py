@@ -52,6 +52,7 @@ from ..net.messenger import Messenger
 from ..net.transport import SendFailure
 from ..types import GroupStatus, NO_REQUEST
 from ..utils.intmap import RowAllocator
+from ..utils.locking import ContendedLock
 from . import state as st
 from .tick import ChainInbox, chain_tick_impl
 
@@ -200,7 +201,8 @@ class ChainModeBNode(ModeBCommon):
         self._frame_applied_tick: Dict[int, int] = {}
         self._last_frame_rx = 0
         self.stats = collections.Counter()
-        self.lock = threading.RLock()
+        self.lock = ContendedLock()
+        self.lock_contended = self.lock.contended
         self._tick = chain_node_tick(self.r)
         self.wal = wal
         if wal is not None:

@@ -97,6 +97,89 @@ def node_tick(r: int):
                    donate_argnums=(0,))
 
 
+@functools.lru_cache(maxsize=None)
+def node_tick_packed(r: int):
+    """Jitted per-node step returning (state', flat_i32) where the flat
+    buffer is pack_outbox(outbox) ++ changed — ONE device->host transfer
+    per tick instead of one per consumed field (see ops/tick.HostOutbox)."""
+    from ..ops.tick import pack_outbox_impl
+
+    def impl(state, inbox):
+        new, out, changed = node_tick_impl(state, inbox, r)
+        flat = jnp.concatenate(
+            [pack_outbox_impl(out), changed.astype(jnp.int32)]
+        )
+        return new, flat
+
+    return jax.jit(impl, donate_argnums=(0,))
+
+
+def unpack_node_tick(flat, R: int, P: int, W: int, G: int):
+    """Host inverse of :func:`node_tick_packed`'s flat buffer."""
+    import numpy as np
+
+    from ..ops.tick import unpack_outbox
+
+    flat = np.asarray(flat)
+    out = unpack_outbox(flat[:-G], R, P, W, G)
+    return out, flat[-G:].astype(bool)
+
+
+@functools.lru_cache(maxsize=None)
+def frame_extract(r: int, K: int):
+    """Jitted own-row gather for frame building: selects ``K`` rows of every
+    frame field in one device program and returns one flat i32 buffer
+    (layout: scalars [S,K] ++ flags [K] ++ rings [NR,K,W] ++ bits [NB,K,W]).
+    The round-2 path sliced ~21 fields individually (one dispatch+transfer
+    each) per frame per tick; K is pow2-padded so the jit cache stays
+    bounded."""
+    from .wire import FLAG_COORD_ACTIVE, FLAG_COORD_PREPARING, RING_BITS, \
+        RINGS, SCALARS
+
+    def impl(state, rows):
+        parts = []
+        for f in SCALARS:
+            parts.append(getattr(state, f)[r, rows])                 # [K]
+        flags = (state.coord_active[r, rows].astype(jnp.int32)
+                 * FLAG_COORD_ACTIVE
+                 + state.coord_preparing[r, rows].astype(jnp.int32)
+                 * FLAG_COORD_PREPARING)
+        parts.append(flags)
+        for f in RINGS + RING_BITS:
+            parts.append(getattr(state, f)[r][:, rows].T)            # [K, W]
+        return jnp.concatenate(
+            [p.astype(jnp.int32).ravel() for p in parts]
+        )
+
+    return jax.jit(impl)
+
+
+def unpack_frame_extract(flat, n: int, K: int, W: int):
+    """Host inverse of :func:`frame_extract`: -> (scalars dict, flags,
+    rings dict, ring_bits dict) truncated to the first ``n`` rows."""
+    import numpy as np
+
+    from .wire import RING_BITS, RINGS, SCALARS
+
+    flat = np.asarray(flat)
+    off = 0
+    scalars = {}
+    for f in SCALARS:
+        scalars[f] = flat[off:off + K][:n]
+        off += K
+    flags = flat[off:off + K][:n]
+    off += K
+    rings = {}
+    for f in RINGS:
+        rings[f] = flat[off:off + K * W].reshape(K, W)[:n]
+        off += K * W
+    bits = {}
+    for f in RING_BITS:
+        bits[f] = flat[off:off + K * W].reshape(K, W)[:n].astype(bool)
+        off += K * W
+    return scalars, flags, rings, bits
+
+
 def mirror_apply_impl(state, sr, rows, scalars, flags, rings, bits):
     """Apply one decoded replica frame to sender ``sr``'s mirror rows in a
     single fused device step.

@@ -29,6 +29,7 @@ import pickle
 import numpy as np
 
 from ..wal.logger import OP_CREATE, OP_REMOVE, OP_TICK, PaxosLogger
+from .kernel import unpack_node_tick
 
 OP_FRAME = 6
 OP_CKPT = 7
@@ -201,9 +202,12 @@ def recover_modeb(cfg, member_ids, node_id, app, log_dir: str,
                 inbox = TickInbox(jnp.asarray(req), jnp.asarray(stp),
                                   jnp.asarray(alive))
                 node._flush_mirrors()  # frames staged since the last tick
-                node.state, out, changed = node._tick(node.state, inbox)
+                node.state, packed = node._tick_packed(node.state, inbox)
+                out, changed = unpack_node_tick(
+                    packed, node.R, node.P, node.W, node.G
+                )
                 node._process_outbox(out)
-                node._dirty |= np.asarray(changed)
+                node._dirty |= changed
                 node.tick_num = tick_num + 1
 
     node._flush_mirrors()  # frames journaled after the last tick record

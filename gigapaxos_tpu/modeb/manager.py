@@ -44,9 +44,11 @@ from ..net.transport import SendFailure
 from ..ops.tick import TickInbox
 from ..types import GroupStatus, NO_REQUEST
 from ..utils.intmap import RowAllocator
+from ..utils.locking import ContendedLock
 from ..paxos import state as st
 from . import wire
-from .kernel import mirror_apply, node_tick
+from .kernel import (frame_extract, mirror_apply, node_tick_packed,
+                     unpack_frame_extract, unpack_node_tick)
 
 #: request ids are node-scoped: high bits carry the origin replica slot so
 #: any node can route the response duty without a lookup (the entry-replica
@@ -131,8 +133,12 @@ class ModeBNode(ModeBCommon):
         self._frame_applied_tick: Dict[int, int] = {}
         self._last_frame_rx = 0  # our tick count when a frame last arrived
         self.stats = collections.Counter()
-        self.lock = threading.RLock()
-        self._tick = node_tick(self.r)
+        self.lock = ContendedLock()
+        self.lock_contended = self.lock.contended
+        self._tick_packed = node_tick_packed(self.r)
+        # preallocated inbox staging (entries cleared lazily next build)
+        self._in_req = np.zeros((self.R, self.P, self.G), np.int32)
+        self._in_stp = np.zeros((self.R, self.P, self.G), bool)
 
         self.wal = wal
         if wal is not None:
@@ -313,13 +319,19 @@ class ModeBNode(ModeBCommon):
             self._refresh_alive()
             self._flush_mirrors()
             inbox = self._build_inbox()
+            # dispatch first, journal second: the WAL append+fsync overlaps
+            # the async device step (BatchedLogger overlap, SURVEY §2.2
+            # item 3); responses stay held until is_synced()
+            self.state, packed = self._tick_packed(self.state, inbox)
             if self.wal is not None:
                 self.wal.log_inbox(self.tick_num, inbox)
-            self.state, out, changed = self._tick(self.state, inbox)
+            out, changed = unpack_node_tick(
+                packed, self.R, self.P, self.W, self.G
+            )
             self._process_outbox(out)
-            self._dirty |= np.asarray(changed)
+            self._dirty |= changed
             self.tick_num += 1
-            frame = self._build_frame()
+            frames = self._build_frames()
             if self.wal is not None:
                 self.wal.maybe_checkpoint()
             self._flush_callbacks()
@@ -327,11 +339,12 @@ class ModeBNode(ModeBCommon):
                 self._check_laggard(out)
             if self.tick_num % 64 == 0:
                 self._sweep()
-        if frame is not None and self.m is not None:
+        if frames and self.m is not None:
             for i, peer in enumerate(self.members):
                 if i != self.r:
                     try:
-                        self.m.send_bytes(peer, frame)
+                        for frame in frames:
+                            self.m.send_bytes(peer, frame)
                     except SendFailure:
                         # transport closing underneath a final tick — the
                         # anti-entropy full frame re-ships state anyway
@@ -339,8 +352,11 @@ class ModeBNode(ModeBCommon):
         return out
 
     def _build_inbox(self) -> TickInbox:
-        req = np.zeros((self.R, self.P, self.G), np.int32)
-        stp = np.zeros((self.R, self.P, self.G), bool)
+        req, stp = self._in_req, self._in_stp
+        for _row, take in self._placed:
+            for _rid, p in take:
+                req[self.r, p, _row] = 0
+                stp[self.r, p, _row] = False
         placed = []
         for row, q in self._queues.items():
             coord = int(self._coord_view[row])
@@ -379,12 +395,13 @@ class ModeBNode(ModeBCommon):
             if take:
                 placed.append((row, take))
         self._placed = placed
-        return TickInbox(jnp.asarray(req), jnp.asarray(stp),
-                         jnp.asarray(self.alive.copy()))
+        # fresh copies for the jit (the staging buffers are mutated next
+        # build; zero-copy dispatch aliasing them would race the async step)
+        return TickInbox(req.copy(), stp.copy(), self.alive.copy())
 
     def _process_outbox(self, out) -> None:
-        self._coord_view = np.asarray(out.coord_id)
-        taken = np.asarray(out.intake_taken[self.r])  # [P, G]
+        self._coord_view = out.coord_id
+        taken = out.intake_taken[self.r]  # [P, G]
         for row, take in self._placed:
             # intake only really happened if WE were the winning coordinator;
             # a write into a peer's mirror ring was discarded by the kernel
@@ -392,10 +409,10 @@ class ModeBNode(ModeBCommon):
             for rid, p in reversed(take):
                 if not (ours and taken[p, row]):
                     self._queues[row].appendleft(rid)
-        er = np.asarray(out.exec_req[self.r])      # [W, G]
-        es = np.asarray(out.exec_stop[self.r])
-        eb = np.asarray(out.exec_base[self.r])     # [G]
-        ec = np.asarray(out.exec_count[self.r])    # [G]
+        er = out.exec_req[self.r]      # [W, G]
+        es = out.exec_stop[self.r]
+        eb = out.exec_base[self.r]     # [G]
+        ec = out.exec_count[self.r]    # [G]
         for row in np.nonzero(ec)[0]:
             name = self.rows.name(int(row))
             if name is None:
@@ -466,7 +483,20 @@ class ModeBNode(ModeBCommon):
             del self.outstanding[rid]
 
     # ------------------------------------------------------------ frames (tx)
-    def _build_frame(self) -> Optional[bytes]:
+    #: soft budget per encoded frame; a full-state frame over a huge group
+    #: population fragments into several frames under this size instead of
+    #: tripping transport MAX_FRAME (the PrepareReplyAssembler analog,
+    #: gigapaxos/paxosutil/PrepareReplyAssembler.java:1-224 — fragmentation
+    #: of oversized replica state under MAX_PAYLOAD_SIZE)
+    FRAME_BUDGET = 4 * 1024 * 1024
+
+    def _row_wire_bytes(self) -> int:
+        """Encoded bytes one group row contributes to a frame."""
+        return (8 + 4 * len(wire.SCALARS) + 4                  # gid+scalars+flags
+                + 4 * self.W * len(wire.RINGS)                 # i32 rings
+                + 4 * len(wire.RING_BITS))                     # W bits -> i32
+
+    def _build_frames(self) -> List[bytes]:
         full = self._force_full or (
             self.anti_entropy_every > 0
             and self.tick_num % self.anti_entropy_every == 0
@@ -489,7 +519,7 @@ class ModeBNode(ModeBCommon):
                     pl, stop = self.payloads[rid]
                     pay.append((rid, stop, pl))
         if len(rows_idx) == 0 and not pay:
-            return None
+            return []
         self._force_full = False
         self._dirty = np.zeros(self.G, bool)
         gids = np.zeros(len(rows_idx), np.uint64)
@@ -498,32 +528,54 @@ class ModeBNode(ModeBCommon):
             gids[i] = wire.gid_of(name) if name is not None else 0
         known = gids != 0
         rows_idx, gids = rows_idx[known], gids[known]
-        s = self.state
-        r = self.r
-        scalars = {
-            f: np.asarray(getattr(s, f)[r])[rows_idx].astype(np.int32)
-            for f in wire.SCALARS
-        }
-        flags = (
-            np.asarray(s.coord_active[r])[rows_idx].astype(np.int32)
-            * wire.FLAG_COORD_ACTIVE
-            + np.asarray(s.coord_preparing[r])[rows_idx].astype(np.int32)
-            * wire.FLAG_COORD_PREPARING
-        )
-        rings = {
-            f: np.asarray(getattr(s, f)[r])[:, rows_idx].T.astype(np.int32)
-            for f in wire.RINGS
-        }
-        ring_bits = {
-            f: np.asarray(getattr(s, f)[r])[:, rows_idx].T
-            for f in wire.RING_BITS
-        }
-        self.stats["frames_sent"] += 1
-        self.stats["frame_groups"] += len(rows_idx)
-        buf = wire.encode_frame(r, self.tick_num, self.W, gids, scalars,
-                                flags, rings, ring_bits, pay, full=full)
-        self.stats["frame_bytes"] += len(buf)
-        return buf
+        per_frame = max(1, self.FRAME_BUDGET // self._row_wire_bytes())
+        # payloads count against the budget too (a tick can place P large
+        # client blobs): greedily split them so no chunk's payload section
+        # exceeds the budget — each frame is then bounded by ~2x budget
+        # (one oversized single payload still ships alone; truly huge blobs
+        # belong on the net/bulk.py out-of-band path)
+        pay_chunks: List[list] = []
+        acc, acc_bytes = [], 0
+        for item in pay:
+            sz = len(item[2]) + 16
+            if acc and acc_bytes + sz > self.FRAME_BUDGET:
+                pay_chunks.append(acc)
+                acc, acc_bytes = [], 0
+            acc.append(item)
+            acc_bytes += sz
+        if acc:
+            pay_chunks.append(acc)
+        frames: List[bytes] = []
+        n_total = len(rows_idx)
+        row_chunks = [
+            (rows_idx[lo:lo + per_frame], gids[lo:lo + per_frame])
+            for lo in range(0, n_total, per_frame)
+        ] or [(rows_idx[:0], gids[:0])]
+        for ci in range(max(len(row_chunks), len(pay_chunks))):
+            chunk_rows, chunk_gids = (
+                row_chunks[ci] if ci < len(row_chunks)
+                else (rows_idx[:0], gids[:0])
+            )
+            chunk_pay = pay_chunks[ci] if ci < len(pay_chunks) else []
+            # one fused device gather + one transfer for all ~21 frame
+            # fields (the round-2 path paid a dispatch+sync per field)
+            n = len(chunk_rows)
+            K = max(16, 1 << max(0, int(n - 1).bit_length()))
+            rpad = np.zeros(K, np.int32)
+            rpad[:n] = chunk_rows
+            flat = frame_extract(self.r, K)(self.state, jnp.asarray(rpad))
+            scalars, flags, rings, ring_bits = unpack_frame_extract(
+                flat, n, K, self.W
+            )
+            self.stats["frames_sent"] += 1
+            self.stats["frame_groups"] += n
+            buf = wire.encode_frame(
+                self.r, self.tick_num, self.W, chunk_gids, scalars, flags,
+                rings, ring_bits, chunk_pay, full=full,
+            )
+            self.stats["frame_bytes"] += len(buf)
+            frames.append(buf)
+        return frames
 
     # ------------------------------------------------------------ frames (rx)
     def _on_frame(self, sender: str, payload: bytes) -> None:
@@ -656,12 +708,15 @@ class ModeBNode(ModeBCommon):
         lag = np.asarray(out.lag[self.r])  # [G]
         need = set(int(x) for x in np.nonzero(lag >= self.W)[0][:16])
         need |= set(list(self._tainted_rows)[:16])
+        if not need:
+            return
+        exec_all = np.asarray(self.state.exec_slot)  # one transfer, not per-row
         for row in need:
             name = self.rows.name(int(row))
             if name is None:
                 self._tainted_rows.discard(row)
                 continue
-            ex = np.asarray(self.state.exec_slot[:, int(row)])
+            ex = exec_all[:, int(row)]
             donors = [i for i in range(self.R)
                       if i != self.r and self.alive[i]]
             if not donors:

@@ -481,6 +481,67 @@ def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1):
 paxos_tick = jax.jit(paxos_tick_impl, donate_argnums=(0,), static_argnums=(2,))
 
 
+class HostOutbox(NamedTuple):
+    """Numpy mirror of :class:`TickOutbox` — what the host control loop
+    actually consumes.  Produced by :func:`unpack_outbox` from ONE device
+    transfer; the per-field ``np.array(out.x)`` pattern costs a fixed
+    ~100-200us dispatch+sync per field and dominated the round-2 host
+    profile (the pipeline analog of PaxosPacketBatcher: ship one buffer,
+    not 26)."""
+
+    exec_req: "np.ndarray"
+    exec_stop: "np.ndarray"
+    exec_base: "np.ndarray"
+    exec_count: "np.ndarray"
+    intake_taken: "np.ndarray"
+    coord_id: "np.ndarray"
+    decided_now: "np.ndarray"
+    lag: "np.ndarray"
+
+
+def pack_outbox_impl(out: TickOutbox) -> jnp.ndarray:
+    """Flatten every outbox field into one i32 vector (single transfer)."""
+    return jnp.concatenate([
+        out.exec_req.ravel(),
+        out.exec_stop.astype(I32).ravel(),
+        out.exec_base.ravel(),
+        out.exec_count.ravel(),
+        out.intake_taken.astype(I32).ravel(),
+        out.coord_id.ravel(),
+        out.decided_now.ravel(),
+        out.lag.ravel(),
+    ])
+
+
+def unpack_outbox(flat, R: int, P: int, W: int, G: int) -> HostOutbox:
+    """Host-side inverse of :func:`pack_outbox_impl` (zero-copy views)."""
+    flat = np.asarray(flat)
+    sizes = [R * W * G, R * W * G, R * G, R * G, R * P * G, G, G, R * G]
+    offs = np.cumsum([0] + sizes)
+    cut = [flat[offs[i]:offs[i + 1]] for i in range(len(sizes))]
+    return HostOutbox(
+        exec_req=cut[0].reshape(R, W, G),
+        exec_stop=cut[1].reshape(R, W, G).astype(bool),
+        exec_base=cut[2].reshape(R, G),
+        exec_count=cut[3].reshape(R, G),
+        intake_taken=cut[4].reshape(R, P, G).astype(bool),
+        coord_id=cut[5],
+        decided_now=cut[6],
+        lag=cut[7].reshape(R, G),
+    )
+
+
+def _paxos_tick_packed_impl(state, inbox: TickInbox, own_row: int = -1):
+    state, out = paxos_tick_impl(state, inbox, own_row)
+    return state, pack_outbox_impl(out)
+
+
+#: fused tick + outbox pack: one dispatch, one device->host buffer
+paxos_tick_packed = jax.jit(
+    _paxos_tick_packed_impl, donate_argnums=(0,), static_argnums=(2,)
+)
+
+
 def make_inbox(n_replicas: int, n_groups: int, per_tick: int) -> TickInbox:
     """An empty inbox template (host fills rows it has traffic for)."""
     return TickInbox(

@@ -57,14 +57,20 @@ class TickDriver:
 
     def _run(self) -> None:
         drain = self.drain_ticks
+        contended = getattr(self.manager, "lock_contended", None)
         while not self._stop.is_set():
             out = self.manager.tick()
             self._first_tick.set()
-            # CPython locks are unfair: without a real sleep here the driver
+            # CPython locks are unfair: without a yield window the driver
             # re-acquires manager.lock before any waiting control-plane
             # thread (propose, create, stop) gets scheduled, starving them
-            # indefinitely.  This yield window is the fairness mechanism.
-            time.sleep(0.0005)
+            # indefinitely.  Waiters flag themselves (utils/locking.py), so
+            # the window is paid only when someone is actually waiting.
+            if contended is None:
+                time.sleep(0.0005)
+            elif contended.is_set():
+                contended.clear()
+                time.sleep(0.0005)
             busy = self.manager.pending_count() > 0
             if not busy:
                 # decided_now needs a device sync; only check when draining

@@ -36,9 +36,10 @@ from ..config import GigapaxosTpuConfig
 from ..models.replicable import Replicable
 from ..types import GroupStatus, NO_REQUEST
 from ..utils.intmap import RowAllocator
-from ..utils.locking import locked as _locked
+from ..utils.locking import ContendedLock, locked as _locked
 from . import state as st
-from ..ops.tick import TickInbox, TickOutbox, paxos_tick
+from ..ops.tick import (HostOutbox, TickInbox, paxos_tick_packed,
+                        unpack_outbox)
 
 
 @dataclass
@@ -110,11 +111,24 @@ class PaxosManager:
         )
         self._last_active = np.zeros(self.G, np.int64)
         self._row_outstanding = collections.Counter()
+        # Host mirrors of config state (member mask / group size).  The tick
+        # never writes these; they change only in create/remove/pause/unpause
+        # — so the hot path (propose placement, execution bookkeeping) reads
+        # numpy instead of paying a jitted scalar-index dispatch per request
+        # (round-2 profile: ~230us per state.n_members[row] lookup).
+        self._member_np = np.zeros((self.R, self.G), bool)
+        self._n_members_np = np.zeros(self.G, np.int32)
+        # preallocated inbox staging buffers; entries placed last tick are
+        # zeroed lazily at the next build instead of reallocating R*P*G
+        self._in_req = np.zeros((self.R, self.P, self.G), np.int32)
+        self._in_stp = np.zeros((self.R, self.P, self.G), bool)
+        self._placed: list = []
         # Control-plane threads (messenger readers, protocol tasks) call the
         # admin/propose API while a tick driver loops on tick(); one reentrant
         # lock serializes them (the reference synchronizes on the instance map
         # the same way, PaxosManager.java:2284-2412).
-        self.lock = threading.RLock()
+        self.lock = ContendedLock()
+        self.lock_contended = self.lock.contended
         if self.wal is not None:
             self.wal.attach(self)
 
@@ -138,6 +152,8 @@ class PaxosManager:
             mask,
             np.array([epoch], np.int32),
         )
+        self._member_np[:, row] = mask[0]
+        self._n_members_np[row] = mask[0].sum()
         self._stopped_rows.discard(row)
         self._last_active[row] = self.tick_num
         if self.wal is not None:
@@ -156,6 +172,8 @@ class PaxosManager:
         if row is None:
             return False
         self.state = st.free_groups(self.state, np.array([row], np.int32))
+        self._member_np[:, row] = False
+        self._n_members_np[row] = 0
         self.rows.free(name)
         self._fail_queued(row)
         self._purge_row_outstanding(row)
@@ -172,7 +190,7 @@ class PaxosManager:
         row = self.rows.row(name)
         if row is None:
             return None
-        return [int(r) for r in np.where(np.array(self.state.member[:, row]))[0]]
+        return [int(r) for r in np.where(self._member_np[:, row])[0]]
 
     @_locked
     def is_stopped(self, name: str) -> bool:
@@ -224,7 +242,7 @@ class PaxosManager:
         idle_after = 0 if ignore_idle else self.cfg.paxos.deactivation_ticks
         exec_slot = np.array(self.state.exec_slot)
         next_slot = np.array(self.state.next_slot)
-        member = np.array(self.state.member)
+        member = self._member_np
         # coldest first so eviction keeps the working set hot
         cands = sorted(
             self.rows.items(), key=lambda kv: self._last_active[kv[1]]
@@ -265,6 +283,8 @@ class PaxosManager:
             self._paused[name] = hri
             rows_to_free.append(row)
         self.state = st.free_groups(self.state, np.array(rows_to_free, np.int32))
+        self._member_np[:, rows_to_free] = False
+        self._n_members_np[rows_to_free] = 0
         for name in names:
             row = self.rows.free(name)
             self._stopped_rows.discard(row)
@@ -285,6 +305,8 @@ class PaxosManager:
             self.state, np.array([row], np.int32), mask,
             np.array([hri["epoch"]], np.int32),
         )
+        self._member_np[:, row] = mask[0]
+        self._n_members_np[row] = mask[0].sum()
         self.state = st.hot_restore(self.state, row, hri)
         if hri.get("stopped"):
             self._stopped_rows.add(row)
@@ -322,7 +344,7 @@ class PaxosManager:
             return None
         rid = self._next_rid
         self._next_rid += 1
-        members = np.where(np.array(self.state.member[:, row]))[0]
+        members = np.where(self._member_np[:, row])[0]
         if entry is None or entry not in members:
             # spread entry replicas across the group's members (not the whole
             # replica set — a non-member never executes, so its callback
@@ -367,8 +389,12 @@ class PaxosManager:
 
     # ------------------------------------------------------------------- tick
     def _build_inbox(self) -> TickInbox:
-        req = np.zeros((self.R, self.P, self.G), np.int32)
-        stp = np.zeros((self.R, self.P, self.G), bool)
+        # lazily clear last tick's placements instead of reallocating R*P*G
+        req, stp = self._in_req, self._in_stp
+        for _row, take in self._placed:
+            for _rid, entry, p in take:
+                req[entry, p, _row] = 0
+                stp[entry, p, _row] = False
         placed = []
         for row, q in self._queues.items():
             used = collections.Counter()
@@ -381,7 +407,7 @@ class PaxosManager:
                 if not self.alive[rec.entry]:
                     # re-home the request to a live *member* so the response
                     # callback is not orphaned on a dead entry node
-                    ms = np.where(np.array(self.state.member[:, row]))[0]
+                    ms = np.where(self._member_np[:, row])[0]
                     live = [m for m in ms if self.alive[m]]
                     if not live:
                         q.appendleft(rid)
@@ -396,18 +422,25 @@ class PaxosManager:
                 req[entry, p, row] = rid
                 stp[entry, p, row] = rec.stop
                 take.append((rid, entry, p))
-            placed.append((row, take))
+            if take:
+                placed.append((row, take))
         self._placed = placed
-        return TickInbox(
-            jnp.asarray(req), jnp.asarray(stp), jnp.asarray(self.alive.copy())
-        )
+        # hand the jit fresh copies (the staging buffers get mutated next
+        # tick; a zero-copy dispatch aliasing them would race the async
+        # step); the WAL reads inbox.alive without a device round-trip
+        return TickInbox(req.copy(), stp.copy(), self.alive.copy())
 
     @_locked
-    def tick(self) -> TickOutbox:
+    def tick(self) -> HostOutbox:
         inbox = self._build_inbox()
+        # dispatch first, journal second: the jitted step runs asynchronously
+        # while the WAL appends+fsyncs this tick's record (SURVEY §2.2 item 3,
+        # the BatchedLogger overlap, AbstractPaxosLogger.java:99-107).  Safe
+        # because responses stay held until is_synced() (log-before-respond).
+        self.state, packed = paxos_tick_packed(self.state, inbox, -1)
         if self.wal is not None:
             self.wal.log_inbox(self.tick_num, inbox)
-        self.state, out = paxos_tick(self.state, inbox)
+        out = unpack_outbox(packed, self.R, self.P, self.W, self.G)  # syncs
         self._process_outbox(out)
         self.tick_num += 1
         if self.wal is not None:
@@ -435,30 +468,27 @@ class PaxosManager:
         for cb, rid, resp in held:
             cb(rid, resp)
 
-    def _process_outbox(self, out: TickOutbox) -> None:
-        taken = np.array(out.intake_taken)
+    def _process_outbox(self, out: HostOutbox) -> None:
+        taken = out.intake_taken
         for row, take in self._placed:
             for rid, entry, p in reversed(take):
                 if not taken[entry, p, row] and rid in self.outstanding:
                     self._queues[row].appendleft(rid)  # retry next tick
-        er = np.array(out.exec_req)
-        es = np.array(out.exec_stop)
-        eb = np.array(out.exec_base)
-        ec = np.array(out.exec_count)
-        active = np.where(ec.sum(axis=0) > 0)[0] if ec.any() else []
-        for row in active:
-            name = self.rows.name(int(row))
-            if name is None:
-                continue
-            self._last_active[row] = self.tick_num
-            for r in range(self.R):
-                n = int(ec[r, row])
-                for j in range(n):
-                    rid = int(er[r, j, row])
-                    slot = int(eb[r, row]) + j
-                    is_stop = bool(es[r, j, row])
-                    self._execute_one(r, int(row), name, rid, slot, is_stop)
-        self.stats["decisions"] += int(np.array(out.decided_now).sum())
+        er, es, eb, ec = out.exec_req, out.exec_stop, out.exec_base, out.exec_count
+        if ec.any():
+            for row in np.where(ec.sum(axis=0) > 0)[0]:
+                name = self.rows.name(int(row))
+                if name is None:
+                    continue
+                self._last_active[row] = self.tick_num
+                for r in range(self.R):
+                    n = int(ec[r, row])
+                    for j in range(n):
+                        rid = int(er[r, j, row])
+                        slot = int(eb[r, row]) + j
+                        is_stop = bool(es[r, j, row])
+                        self._execute_one(r, int(row), name, rid, slot, is_stop)
+        self.stats["decisions"] += int(out.decided_now.sum())
 
     def _execute_one(self, r: int, row: int, name: str, rid: int, slot: int,
                      is_stop: bool) -> None:
@@ -487,7 +517,7 @@ class PaxosManager:
             rec.responded = True
             if rec.callback is not None:
                 self._held_callbacks.append((rec.callback, rid, response))
-        members = int(self.state.n_members[row])
+        members = int(self._n_members_np[row])
         if len(rec.executed_by) >= members and rec.responded:
             del self.outstanding[rid]
             self._row_outstanding[row] -= 1
@@ -499,7 +529,7 @@ class PaxosManager:
         if not self.outstanding:
             return
         exec_slot = np.array(self.state.exec_slot)
-        member = np.array(self.state.member)
+        member = self._member_np
         dead = []
         for rid, rec in self.outstanding.items():
             if not rec.responded or rec.slot < 0:
@@ -528,7 +558,7 @@ class PaxosManager:
         if row is None:
             return False
         exec_slot = np.array(self.state.exec_slot[:, row])
-        members = np.where(np.array(self.state.member[:, row]))[0]
+        members = np.where(self._member_np[:, row])[0]
         donors = [m for m in members if self.alive[m] and m != r]
         if not donors:
             return False

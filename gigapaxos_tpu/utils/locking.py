@@ -9,6 +9,42 @@ is that convention in one place.
 from __future__ import annotations
 
 import functools
+import threading
+
+
+class ContendedLock:
+    """Reentrant lock that flags when an acquirer found it taken.
+
+    CPython locks are unfair: a spinning tick driver re-acquires before any
+    waiting control-plane thread (propose, create, stop) gets scheduled,
+    starving them indefinitely.  The round-2 fix was an unconditional 0.5 ms
+    sleep per tick — a hard ~2k ticks/s ceiling.  Instead, waiters set
+    ``contended`` and the driver yields a window only when someone actually
+    waited (see paxos/driver.py)."""
+
+    __slots__ = ("_lock", "contended")
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.contended = threading.Event()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if self._lock.acquire(blocking=False):
+            return True
+        if not blocking:
+            return False
+        self.contended.set()
+        return self._lock.acquire(timeout=timeout)
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._lock.release()
 
 
 def locked(fn):

@@ -303,7 +303,7 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
     import jax.numpy as jnp
 
     from ..paxos.manager import PaxosManager, RequestRecord
-    from ..ops.tick import TickInbox, paxos_tick
+    from ..ops.tick import TickInbox, paxos_tick_packed, unpack_outbox
     from .journal import read_journal
 
     logger = PaxosLogger(log_dir, native=native)
@@ -319,6 +319,8 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
             meta, npz_blob = pickle.loads(f.read())
         arrs = np.load(io.BytesIO(npz_blob))
         m.state = PaxosState(**{f: jnp.asarray(arrs[f]) for f in PaxosState._fields})
+        m._member_np = np.asarray(m.state.member).copy()
+        m._n_members_np = np.asarray(m.state.n_members).copy()
         m.tick_num = meta["tick_num"]
         m._next_rid = meta["next_rid"]
         m.rows.restore(meta["rows"], meta.get("free_rows"))
@@ -364,8 +366,12 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
         return TickInbox(jnp.asarray(bufs[0]), jnp.asarray(bufs[1]),
                          jnp.asarray(alive))
 
+    def tick_host(state, inbox):
+        state, packed = paxos_tick_packed(state, inbox, -1)
+        return state, unpack_outbox(packed, m.R, m.P, m.W, m.G)
+
     replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
-                    build_inbox, paxos_tick)
+                    build_inbox, tick_host)
     # reattach logging
     logger.attach(m)
     m.wal = logger

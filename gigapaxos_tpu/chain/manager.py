@@ -21,7 +21,6 @@ Differences from the paxos manager, mirroring protocol semantics:
 from __future__ import annotations
 
 import collections
-import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -34,7 +33,8 @@ from ..types import NO_REQUEST
 from ..utils.intmap import RowAllocator
 from ..utils.locking import ContendedLock, locked as _locked
 from . import state as st
-from .tick import ChainInbox, ChainOutbox, chain_tick
+from .tick import (ChainInbox, HostChainOutbox, chain_tick_packed,
+                   unpack_chain_outbox)
 
 
 @dataclass
@@ -77,8 +77,13 @@ class ChainManager:
         self._held_callbacks: list = []
         self.stats = collections.Counter()
         self._stopped_rows: set[int] = set()
+        # host mirrors of config state (see paxos/manager.py rationale)
+        self._member_np = np.zeros((self.R, self.G), bool)
+        self._n_members_np = np.zeros(self.G, np.int32)
+        self._in_req = np.zeros((self.P, self.G), np.int32)
+        self._in_stp = np.zeros((self.P, self.G), bool)
+        self._placed: list = []
         self.lock = ContendedLock()
-        self.lock_contended = self.lock.contended
         if self.wal is not None:
             self.wal.attach(self)
 
@@ -98,6 +103,8 @@ class ChainManager:
             self.state, np.array([row], np.int32), mask,
             np.array([epoch], np.int32),
         )
+        self._member_np[:, row] = mask[0]
+        self._n_members_np[row] = mask[0].sum()
         self._stopped_rows.discard(row)
         if self.wal is not None:
             self.wal.log_create(name, members, epoch)
@@ -109,6 +116,8 @@ class ChainManager:
         if row is None:
             return False
         self.state = st.free_groups(self.state, np.array([row], np.int32))
+        self._member_np[:, row] = False
+        self._n_members_np[row] = 0
         self.rows.free(name)
         self._fail_queued(row)
         self._stopped_rows.discard(row)
@@ -121,7 +130,7 @@ class ChainManager:
         row = self.rows.row(name)
         if row is None:
             return None
-        return [int(r) for r in np.where(np.array(self.state.member[:, row]))[0]]
+        return [int(r) for r in np.where(self._member_np[:, row])[0]]
 
     @_locked
     def is_stopped(self, name: str) -> bool:
@@ -179,8 +188,11 @@ class ChainManager:
 
     # ------------------------------------------------------------------- tick
     def _build_inbox(self) -> ChainInbox:
-        req = np.zeros((self.P, self.G), np.int32)
-        stp = np.zeros((self.P, self.G), bool)
+        req, stp = self._in_req, self._in_stp
+        for _row, take in self._placed:
+            for _rid, _e, p in take:
+                req[p, _row] = 0
+                stp[p, _row] = False
         placed = []
         for row, q in self._queues.items():
             take = []
@@ -192,18 +204,22 @@ class ChainManager:
                 req[p, row] = rid
                 stp[p, row] = self.outstanding[rid].stop
                 take.append((rid, 0, p))
-            placed.append((row, take))
+            if take:
+                placed.append((row, take))
         self._placed = placed
-        return ChainInbox(
-            jnp.asarray(req), jnp.asarray(stp), jnp.asarray(self.alive.copy())
-        )
+        # fresh copies: the staging buffers are mutated next build, and the
+        # WAL reads inbox.alive without a device round-trip
+        return ChainInbox(req.copy(), stp.copy(), self.alive.copy())
 
     @_locked
-    def tick(self) -> ChainOutbox:
+    def tick(self) -> HostChainOutbox:
         inbox = self._build_inbox()
+        # dispatch first, journal second: the WAL fsync overlaps the async
+        # device step (see paxos/manager.py tick)
+        self.state, packed = chain_tick_packed(self.state, inbox)
         if self.wal is not None:
             self.wal.log_inbox(self.tick_num, inbox)
-        self.state, out = chain_tick(self.state, inbox)
+        out = unpack_chain_outbox(packed, self.R, self.P, self.W, self.G)
         self._process_outbox(out)
         self.tick_num += 1
         if self.wal is not None:
@@ -224,16 +240,14 @@ class ChainManager:
         for cb, rid, resp in held:
             cb(rid, resp)
 
-    def _process_outbox(self, out: ChainOutbox) -> None:
-        taken = np.array(out.intake_taken)
+    def _process_outbox(self, out: HostChainOutbox) -> None:
+        taken = out.intake_taken
         for row, take in self._placed:
             for rid, _entry, p in reversed(take):
                 if not taken[p, row] and rid in self.outstanding:
                     self._queues[row].appendleft(rid)
-        er = np.array(out.exec_req)
-        es = np.array(out.exec_stop)
-        ec = np.array(out.exec_count)
-        tail = np.array(out.tail_id)
+        er, es, ec = out.exec_req, out.exec_stop, out.exec_count
+        tail = out.tail_id
         active = np.where(ec.sum(axis=0) > 0)[0] if ec.any() else []
         for row in active:
             name = self.rows.name(int(row))
@@ -247,7 +261,7 @@ class ChainManager:
                     self._execute_one(
                         r, int(row), name, rid, is_stop, r == int(tail[row])
                     )
-        self.stats["decisions"] += int(np.array(out.committed_now).sum())
+        self.stats["decisions"] += int(out.committed_now.sum())
 
     def _execute_one(self, r: int, row: int, name: str, rid: int,
                      is_stop: bool, at_tail: bool) -> None:
@@ -269,7 +283,7 @@ class ChainManager:
             rec.responded = True
             if rec.callback is not None:
                 self._held_callbacks.append((rec.callback, rid, response))
-        members = int(self.state.n_members[row])
+        members = int(self._n_members_np[row])
         if rec.responded and len(rec.executed_by) >= members:
             del self.outstanding[rid]
 
@@ -281,7 +295,7 @@ class ChainManager:
         ring or by checkpoint transfer, not from the host payload store)."""
         if not self.outstanding:
             return
-        member = np.array(self.state.member)
+        member = self._member_np
         dead = []
         for rid, rec in self.outstanding.items():
             if not rec.responded:

@@ -37,7 +37,6 @@ from __future__ import annotations
 import collections
 import functools
 import struct
-import threading
 from typing import Callable, Dict, List, Optional
 
 import jax
@@ -108,6 +107,72 @@ def chain_node_tick_impl(state, inbox: ChainInbox, r: int):
 def chain_node_tick(r: int):
     return jax.jit(functools.partial(chain_node_tick_impl, r=r),
                    donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def chain_node_tick_packed(r: int):
+    """Jitted node step returning (state', flat_i32): packed outbox ++
+    changed, ONE device->host transfer per tick (see ops/tick.HostOutbox)."""
+    from .tick import pack_chain_outbox_impl
+
+    def impl(state, inbox):
+        new, out, changed = chain_node_tick_impl(state, inbox, r)
+        flat = jnp.concatenate(
+            [pack_chain_outbox_impl(out), changed.astype(jnp.int32)]
+        )
+        return new, flat
+
+    return jax.jit(impl, donate_argnums=(0,))
+
+
+def unpack_chain_node_tick(flat, R: int, P: int, W: int, G: int):
+    from .tick import unpack_chain_outbox
+
+    flat = np.asarray(flat)
+    out = unpack_chain_outbox(flat[:-G], R, P, W, G)
+    return out, flat[-G:].astype(bool)
+
+
+@functools.lru_cache(maxsize=None)
+def chain_frame_extract(r: int, K: int):
+    """Jitted own-row gather of all chain frame fields for K (pow2-padded)
+    rows in one device program / one transfer (see modeb.kernel.frame_extract
+    — the per-field slice path paid a dispatch+sync per field per tick).
+    Layout: applied[K] ++ status[K] ++ next_slot[K] ++ c_req[K,W] ++
+    c_slot[K,W] ++ c_stop[K,W]."""
+
+    def impl(state, rows):
+        parts = [
+            state.applied[r, rows],
+            state.status[r, rows],
+            state.next_slot[rows],
+            state.c_req[r][:, rows].T,
+            state.c_slot[r][:, rows].T,
+            state.c_stop[r][:, rows].T,
+        ]
+        return jnp.concatenate(
+            [p.astype(jnp.int32).ravel() for p in parts]
+        )
+
+    return jax.jit(impl)
+
+
+def unpack_chain_frame_extract(flat, n: int, K: int, W: int):
+    """Host inverse of :func:`chain_frame_extract` -> (scalars, rings, bits)
+    dicts truncated to the first ``n`` rows."""
+    flat = np.asarray(flat)
+    scalars = {
+        "applied": flat[0:K][:n],
+        "status": flat[K:2 * K][:n],
+        "next_slot": flat[2 * K:3 * K][:n],
+    }
+    off = 3 * K
+    rings = {}
+    for f in ("c_req", "c_slot"):
+        rings[f] = flat[off:off + K * W].reshape(K, W)[:n]
+        off += K * W
+    bits = {"c_stop": flat[off:off + K * W].reshape(K, W)[:n].astype(bool)}
+    return scalars, rings, bits
 
 
 def chain_mirror_apply_impl(state, sr, rows, scalars, bits_stop, rings,
@@ -194,6 +259,9 @@ class ChainModeBNode(ModeBCommon):
         self._tainted_rows: set = set()
         self._await_commit: list = []  # records applied locally, commit TBD
         self._dirty = np.zeros(self.G, bool)
+        self._occupied = np.zeros(self.G, bool)  # live rows (frame targets)
+        self._ae_phase = (np.arange(self.G, dtype=np.int64)
+                          % max(anti_entropy_every, 1))
         self._force_full = True
         self._placed: list = []
         self._pending_whois: set = set()
@@ -202,8 +270,9 @@ class ChainModeBNode(ModeBCommon):
         self._last_frame_rx = 0
         self.stats = collections.Counter()
         self.lock = ContendedLock()
-        self.lock_contended = self.lock.contended
-        self._tick = chain_node_tick(self.r)
+        self._tick_packed = chain_node_tick_packed(self.r)
+        self._in_req = np.zeros((self.P, self.G), np.int32)
+        self._in_stp = np.zeros((self.P, self.G), bool)
         self.wal = wal
         if wal is not None:
             wal.attach(self)
@@ -247,6 +316,7 @@ class ChainModeBNode(ModeBCommon):
             self._row_meta[row] = (name, list(members), epoch)
             self._stopped_rows.discard(row)
             self._dirty[row] = True
+            self._occupied[row] = True
             if self.wal is not None:
                 self.wal.log_create(name, list(members), epoch)
             return True
@@ -264,6 +334,8 @@ class ChainModeBNode(ModeBCommon):
             self._row_meta.pop(row, None)
             self._queues.pop(row, None)
             self._stopped_rows.discard(row)
+            self._occupied[row] = False
+            self._dirty[row] = False
             self._purge_staged_row(row)
             return True
 
@@ -362,13 +434,18 @@ class ChainModeBNode(ModeBCommon):
             self._refresh_alive()
             self._flush_mirrors()
             inbox = self._build_inbox()
+            # dispatch first, journal second: the WAL fsync overlaps the
+            # async device step (see paxos/manager.py tick)
+            self.state, packed = self._tick_packed(self.state, inbox)
             if self.wal is not None:
                 self.wal.log_inbox(self.tick_num, inbox)
-            self.state, out, changed = self._tick(self.state, inbox)
+            out, changed = unpack_chain_node_tick(
+                packed, self.R, self.P, self.W, self.G
+            )
             self._process_outbox(out)
-            self._dirty |= np.asarray(changed)
+            self._dirty |= changed
             self.tick_num += 1
-            frame = self._build_frame()
+            frames = self._build_frames()
             if self.wal is not None:
                 self.wal.maybe_checkpoint()
             self._release_committed()
@@ -377,18 +454,22 @@ class ChainModeBNode(ModeBCommon):
                 self._check_laggard()
             if self.tick_num % 64 == 0:
                 self._sweep()
-        if frame is not None and self.m is not None:
+        if frames and self.m is not None:
             for i, peer in enumerate(self.members):
                 if i != self.r:
                     try:
-                        self.m.send_bytes(peer, frame)
+                        for frame in frames:
+                            self.m.send_bytes(peer, frame)
                     except SendFailure:
                         self.stats["send_failures"] += 1
         return out
 
     def _build_inbox(self) -> ChainInbox:
-        req = np.zeros((self.P, self.G), np.int32)
-        stp = np.zeros((self.P, self.G), bool)
+        req, stp = self._in_req, self._in_stp
+        for _row, take in self._placed:
+            for _rid, p in take:
+                req[p, _row] = 0
+                stp[p, _row] = False
         placed = []
         for row, q in self._queues.items():
             head = self._head_of(row)
@@ -424,19 +505,20 @@ class ChainModeBNode(ModeBCommon):
             if take:
                 placed.append((row, take))
         self._placed = placed
-        return ChainInbox(jnp.asarray(req), jnp.asarray(stp),
-                          jnp.asarray(self.alive.copy()))
+        # fresh copies: staging buffers are mutated next build (see
+        # paxos/manager.py), and the WAL reads inbox.alive host-side
+        return ChainInbox(req.copy(), stp.copy(), self.alive.copy())
 
     def _process_outbox(self, out) -> None:
-        taken = np.asarray(out.intake_taken)  # [P, G]
+        taken = out.intake_taken  # [P, G]
         for row, take in self._placed:
             for rid, p in reversed(take):
                 if not taken[p, row]:
                     self._queues[row].appendleft(rid)
-        er = np.asarray(out.exec_req[self.r])   # [W, G]
-        es = np.asarray(out.exec_stop[self.r])
-        eb = np.asarray(out.exec_base[self.r])
-        ec = np.asarray(out.exec_count[self.r])
+        er = out.exec_req[self.r]   # [W, G]
+        es = out.exec_stop[self.r]
+        eb = out.exec_base[self.r]
+        ec = out.exec_count[self.r]
         for row in np.nonzero(ec)[0]:
             name = self.rows.name(int(row))
             if name is None:
@@ -504,17 +586,27 @@ class ChainModeBNode(ModeBCommon):
             del self.outstanding[rid]
 
     # ------------------------------------------------------------ frames (tx)
-    def _build_frame(self) -> Optional[bytes]:
-        full = self._force_full or (
-            self.anti_entropy_every > 0
-            and self.tick_num % self.anti_entropy_every == 0
-        )
+    #: soft budget per encoded frame (PrepareReplyAssembler analog — see
+    #: modeb/manager.py.FRAME_BUDGET)
+    FRAME_BUDGET = 4 * 1024 * 1024
+
+    def _row_wire_bytes(self) -> int:
+        return (8 + 4 * len(CH_SCALARS) + 4       # gid + scalars + flags
+                + 4 * self.W * len(CH_RINGS)       # i32 rings
+                + 4 * len(CH_BITS))                # W bits -> one i32
+
+    def _build_frames(self) -> List[bytes]:
+        full = self._force_full
         if full:
-            mask = np.zeros(self.G, bool)
-            for _, row in self.rows.items():
-                mask[row] = True
+            mask = self._occupied.copy()
         else:
-            mask = self._dirty
+            mask = self._dirty.copy()
+            if self.anti_entropy_every > 0:
+                # rotating anti-entropy (see modeb/manager.py): per-tick 1/N
+                # occupied-row slice instead of an O(G) full-frame burst
+                mask |= self._occupied & (
+                    self._ae_phase == self.tick_num % self.anti_entropy_every
+                )
         rows_idx = np.nonzero(mask)[0]
         pay = []
         for row, take in self._placed:
@@ -526,7 +618,7 @@ class ChainModeBNode(ModeBCommon):
                     pl, stop = self.payloads[rid]
                     pay.append((rid, stop, pl))
         if len(rows_idx) == 0 and not pay:
-            return None
+            return []
         self._force_full = False
         self._dirty = np.zeros(self.G, bool)
         gids = np.zeros(len(rows_idx), np.uint64)
@@ -535,27 +627,51 @@ class ChainModeBNode(ModeBCommon):
             gids[i] = wire.gid_of(name) if name is not None else 0
         known = gids != 0
         rows_idx, gids = rows_idx[known], gids[known]
-        s = self.state
-        r = self.r
-        scalars = {
-            "applied": np.asarray(s.applied[r])[rows_idx].astype(np.int32),
-            "status": np.asarray(s.status[r])[rows_idx].astype(np.int32),
-            "next_slot": np.asarray(s.next_slot)[rows_idx].astype(np.int32),
-        }
-        rings = {
-            f: np.asarray(getattr(s, f)[r])[:, rows_idx].T.astype(np.int32)
-            for f in CH_RINGS
-        }
-        bits = {"c_stop": np.asarray(s.c_stop[r])[:, rows_idx].T}
-        self.stats["frames_sent"] += 1
-        buf = wire.encode_frame(
-            r, self.tick_num, self.W, gids, scalars,
-            np.zeros(len(rows_idx), np.int32), rings, bits, pay, full=full,
-            scalar_fields=CH_SCALARS, ring_fields=CH_RINGS,
-            bit_fields=CH_BITS, magic=CH_MAGIC,
-        )
-        self.stats["frame_bytes"] += len(buf)
-        return buf
+        per_frame = max(1, self.FRAME_BUDGET // self._row_wire_bytes())
+        pay_chunks: List[list] = []
+        acc, acc_bytes = [], 0
+        for item in pay:
+            sz = len(item[2]) + 16
+            if acc and acc_bytes + sz > self.FRAME_BUDGET:
+                pay_chunks.append(acc)
+                acc, acc_bytes = [], 0
+            acc.append(item)
+            acc_bytes += sz
+        if acc:
+            pay_chunks.append(acc)
+        frames: List[bytes] = []
+        n_total = len(rows_idx)
+        row_chunks = [
+            (rows_idx[lo:lo + per_frame], gids[lo:lo + per_frame])
+            for lo in range(0, n_total, per_frame)
+        ] or [(rows_idx[:0], gids[:0])]
+        for ci in range(max(len(row_chunks), len(pay_chunks))):
+            chunk_rows, chunk_gids = (
+                row_chunks[ci] if ci < len(row_chunks)
+                else (rows_idx[:0], gids[:0])
+            )
+            chunk_pay = pay_chunks[ci] if ci < len(pay_chunks) else []
+            # one fused device gather + one transfer for all frame fields
+            n = len(chunk_rows)
+            K = max(16, 1 << max(0, int(n - 1).bit_length()))
+            rpad = np.zeros(K, np.int32)
+            rpad[:n] = chunk_rows
+            flat = chain_frame_extract(self.r, K)(
+                self.state, jnp.asarray(rpad)
+            )
+            scalars, rings, bits = unpack_chain_frame_extract(
+                flat, n, K, self.W
+            )
+            self.stats["frames_sent"] += 1
+            buf = wire.encode_frame(
+                self.r, self.tick_num, self.W, chunk_gids, scalars,
+                np.zeros(n, np.int32), rings, bits, chunk_pay, full=full,
+                scalar_fields=CH_SCALARS, ring_fields=CH_RINGS,
+                bit_fields=CH_BITS, magic=CH_MAGIC,
+            )
+            self.stats["frame_bytes"] += len(buf)
+            frames.append(buf)
+        return frames
 
     # ------------------------------------------------------------ frames (rx)
     def _on_frame(self, sender: str, payload: bytes) -> None:

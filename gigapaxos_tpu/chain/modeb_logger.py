@@ -9,15 +9,12 @@ snapshot + in-order replay through the same jitted kernel, followed by
 
 from __future__ import annotations
 
-import glob
 import io
-import os
 import pickle
 
 import numpy as np
 
-from ..modeb.logger import ModeBLogger, OP_CKPT, OP_FRAME
-from ..wal.logger import OP_CREATE, OP_REMOVE, OP_TICK
+from ..modeb.logger import ModeBLogger, replay_node_journals
 
 
 class ChainBLogger(ModeBLogger):
@@ -57,9 +54,9 @@ def recover_chain_modeb(cfg, member_ids, node_id, app, log_dir: str,
     import jax.numpy as jnp
 
     from ..modeb import wire
-    from ..wal.journal import read_journal
     from .modeb import (CH_BITS, CH_MAGIC, CH_RINGS, CH_SCALARS,
-                        ChainBRecord, ChainModeBNode, RID_MASK, RID_SHIFT)
+                        ChainBRecord, ChainModeBNode,
+                        unpack_chain_node_tick)
     from .state import ChainState
     from .tick import ChainInbox
 
@@ -77,6 +74,8 @@ def recover_chain_modeb(cfg, member_ids, node_id, app, log_dir: str,
         node.tick_num = meta["tick_num"]
         node._next_seq = meta["next_seq"]
         node.rows.restore(meta["rows"], meta["free_rows"])
+        for _row in meta["rows"].values():
+            node._occupied[_row] = True  # frame-target mask (anti-entropy)
         node._gid_row = {wire.gid_of(n): row for n, row in meta["rows"].items()}
         node._row_meta = dict(meta["row_meta"])
         node._stopped_rows = set(meta["stopped_rows"])
@@ -96,70 +95,29 @@ def recover_chain_modeb(cfg, member_ids, node_id, app, log_dir: str,
             node.app.restore(name, blob)
         start_seq = snap_seq
 
-    for path in sorted(glob.glob(os.path.join(log_dir, "journal.*.log"))):
-        seq = int(os.path.basename(path).split(".")[1])
-        if seq < start_seq:
-            continue
-        for raw in read_journal(path):
-            rec = pickle.loads(raw)
-            op = rec[0]
-            if op == OP_CREATE:
-                _, name, members, epoch = rec
-                if name not in node.rows:
-                    node.create_group(name, members, epoch)
-            elif op == OP_REMOVE:
-                node.remove_group(rec[1])
-            elif op == OP_FRAME:
-                try:
-                    node._stage_frame(wire.decode_frame(
-                        rec[1], scalar_fields=CH_SCALARS,
-                        ring_fields=CH_RINGS, bit_fields=CH_BITS,
-                        magic=CH_MAGIC,
-                    ))
-                except (ValueError, IndexError):
-                    pass  # tolerate a frame torn by the crash
-            elif op == OP_CKPT:
-                _, gid, packet = rec
-                row = node._gid_row.get(gid)
-                if row is not None:
-                    node._apply_ckpt(row, packet)
-            elif op == OP_TICK:
-                _, tick_num, placed, alive_b = rec
-                if tick_num < node.tick_num:
-                    continue  # already inside the snapshot
-                req = np.zeros((node.P, node.G), np.int32)
-                stp = np.zeros((node.P, node.G), bool)
-                node._placed = []
-                for row, entries in placed:
-                    take = []
-                    placed_rids = set()
-                    for rid, p, payload, stop in entries:
-                        if (rid >> RID_SHIFT) == node.r:
-                            node._next_seq = max(
-                                node._next_seq, (rid & RID_MASK) + 1
-                            )
-                        placed_rids.add(rid)
-                        if (rid not in node.outstanding
-                                and rid not in node.payloads):
-                            node.payloads[rid] = (payload, stop)
-                        req[p, row] = rid
-                        stp[p, row] = stop
-                        take.append((rid, p))
-                    node._placed.append((row, take))
-                    if row in node._queues and placed_rids:
-                        node._queues[row] = collections.deque(
-                            r for r in node._queues[row]
-                            if r not in placed_rids
-                        )
-                node._flush_mirrors()
-                inbox = ChainInbox(
-                    jnp.asarray(req), jnp.asarray(stp),
-                    jnp.asarray(np.frombuffer(alive_b, dtype=bool)),
-                )
-                node.state, out, changed = node._tick(node.state, inbox)
-                node._process_outbox(out)
-                node._dirty |= np.asarray(changed)
-                node.tick_num = tick_num + 1
+    def stage(raw: bytes) -> None:
+        node._stage_frame(wire.decode_frame(
+            raw, scalar_fields=CH_SCALARS, ring_fields=CH_RINGS,
+            bit_fields=CH_BITS, magic=CH_MAGIC,
+        ))
+
+    def new_buffers():
+        return (np.zeros((node.P, node.G), np.int32),
+                np.zeros((node.P, node.G), bool))
+
+    def place(bufs, p, row, rid, stop):
+        bufs[0][p, row] = rid
+        bufs[1][p, row] = stop
+
+    def run_tick(bufs, alive):
+        inbox = ChainInbox(jnp.asarray(bufs[0]), jnp.asarray(bufs[1]),
+                           jnp.asarray(alive))
+        node.state, packed = node._tick_packed(node.state, inbox)
+        return unpack_chain_node_tick(packed, node.R, node.P, node.W, node.G)
+
+    replay_node_journals(node, log_dir, start_seq, stage=stage,
+                         new_buffers=new_buffers, place=place,
+                         run_tick=run_tick)
 
     node._flush_mirrors()
     node._held_callbacks = []  # no live clients to answer during replay

@@ -35,6 +35,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..ops.window import gather_planes
 from ..types import GroupStatus, NO_REQUEST
@@ -258,6 +259,58 @@ def chain_tick_impl(state, inbox: ChainInbox, own_row: int = -1):
 @partial(jax.jit, donate_argnums=(0,))
 def chain_tick(state, inbox: ChainInbox):
     return chain_tick_impl(state, inbox)
+
+
+class HostChainOutbox(NamedTuple):
+    """Numpy mirror of :class:`ChainOutbox` fetched in ONE device->host
+    transfer (see ops/tick.HostOutbox for the rationale)."""
+
+    exec_req: "np.ndarray"
+    exec_stop: "np.ndarray"
+    exec_base: "np.ndarray"
+    exec_count: "np.ndarray"
+    intake_taken: "np.ndarray"
+    head_id: "np.ndarray"
+    tail_id: "np.ndarray"
+    committed_now: "np.ndarray"
+
+
+def pack_chain_outbox_impl(out: ChainOutbox) -> jnp.ndarray:
+    return jnp.concatenate([
+        out.exec_req.ravel(),
+        out.exec_stop.astype(I32).ravel(),
+        out.exec_base.ravel(),
+        out.exec_count.ravel(),
+        out.intake_taken.astype(I32).ravel(),
+        out.head_id.ravel(),
+        out.tail_id.ravel(),
+        out.committed_now.ravel(),
+    ])
+
+
+def unpack_chain_outbox(flat, R: int, P: int, W: int, G: int) -> HostChainOutbox:
+    flat = np.asarray(flat)
+    sizes = [R * W * G, R * W * G, R * G, R * G, P * G, G, G, G]
+    offs = [0]
+    for s in sizes:
+        offs.append(offs[-1] + s)
+    cut = [flat[offs[i]:offs[i + 1]] for i in range(len(sizes))]
+    return HostChainOutbox(
+        exec_req=cut[0].reshape(R, W, G),
+        exec_stop=cut[1].reshape(R, W, G).astype(bool),
+        exec_base=cut[2].reshape(R, G),
+        exec_count=cut[3].reshape(R, G),
+        intake_taken=cut[4].reshape(P, G).astype(bool),
+        head_id=cut[5],
+        tail_id=cut[6],
+        committed_now=cut[7],
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def chain_tick_packed(state, inbox: ChainInbox):
+    state, out = chain_tick_impl(state, inbox)
+    return state, pack_chain_outbox_impl(out)
 
 
 def make_inbox(n_replicas: int, n_groups: int, per_tick: int) -> ChainInbox:

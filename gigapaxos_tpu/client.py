@@ -135,26 +135,47 @@ class ReconfigurableAppClient:
                 self._cv.wait(timeout=left)
             return self._results.pop(rid)
 
-    def _rpc_rc(self, packet: dict, timeout: float, tries: int = 3) -> dict:
-        """Send a control request to reconfigurators, rotating on timeout."""
+    def _rpc_rc(self, packet: dict, timeout: float, tries: int = 3,
+                on_reply=None) -> dict:
+        """Send a control request to reconfigurators, rotating on timeout.
+
+        ``on_reply(resp, retried)`` may map the response before it is
+        returned; ``retried`` is True when an earlier attempt timed out
+        (it may have committed server-side)."""
         last: Optional[Exception] = None
         per = max(timeout / tries, 0.5)
+        retried = False
         for _ in range(tries):
             rc = next(self._rc_rr)
             p = dict(packet)
             p["rid"] = self._rid()
             try:
                 self.m.send(rc, self._stamp(p))
-                return self._await(p["rid"], per)
+                resp = self._await(p["rid"], per)
             except TimeoutError as e:
                 last = e
+                retried = True
+                continue
+            return on_reply(resp, retried) if on_reply else resp
         raise TimeoutError(str(last))
 
     # ------------------------------------------------------- name management
     def create(self, name: str, initial_state: bytes = b"",
                timeout: float = 15.0) -> dict:
+        def on_reply(resp: dict, retried: bool) -> dict:
+            if (not resp.get("ok") and resp.get("error") == "exists"
+                    and retried):
+                # a retransmission racing our own earlier (slow) attempt:
+                # the name exists because WE created it — idempotent success
+                # (the reference's client tolerates DUPLICATE_ERROR on
+                # retried creates the same way,
+                # ReconfigurableAppClientAsync.java:35)
+                return dict(resp, ok=True, note="created_by_earlier_attempt")
+            return resp
+
         return self._rpc_rc(
-            pkt.create_service_name(name, initial_state, 0), timeout
+            pkt.create_service_name(name, initial_state, 0), timeout,
+            on_reply=on_reply,
         )
 
     def create_batch(self, items, timeout: float = 30.0) -> dict:

@@ -35,6 +35,83 @@ OP_FRAME = 6
 OP_CKPT = 7
 
 
+def replay_node_journals(node, log_dir, start_seq, stage, new_buffers,
+                         place, run_tick) -> None:
+    """Shared Mode B journal-replay loop (paxos + chain node flavors).
+
+    The protocol-specific parts are injected: ``stage`` decodes+stages one
+    journaled frame's raw bytes, ``new_buffers``/``place`` shape the tick's
+    intake, ``run_tick`` runs the jitted step and returns (out, changed).
+    Everything else — create/remove/ckpt replay, the snapshot-boundary
+    skip, rid-counter repair from placed intake, snapshot-queue dedup
+    against journaled placements, mirror flushing — is identical across
+    flavors and lives here once (the chain flavor previously carried a
+    line-for-line copy)."""
+    import collections
+
+    from ..wal.journal import read_journal
+    from .common import RID_MASK, rid_origin
+
+    for path in sorted(glob.glob(os.path.join(log_dir, "journal.*.log"))):
+        seq = int(os.path.basename(path).split(".")[1])
+        if seq < start_seq:
+            continue
+        for raw in read_journal(path):
+            rec = pickle.loads(raw)
+            op = rec[0]
+            if op == OP_CREATE:
+                _, name, members, epoch = rec
+                if name not in node.rows:
+                    node.create_group(name, members, epoch)
+            elif op == OP_REMOVE:
+                node.remove_group(rec[1])
+            elif op == OP_FRAME:
+                try:
+                    stage(rec[1])
+                except (ValueError, IndexError):
+                    pass  # tolerate a frame torn by the crash
+            elif op == OP_CKPT:
+                _, gid, packet = rec
+                row = node._gid_row.get(gid)
+                if row is not None:
+                    node._apply_ckpt(row, packet)
+            elif op == OP_TICK:
+                _, tick_num, placed, alive_b = rec
+                if tick_num < node.tick_num:
+                    continue  # already inside the snapshot
+                bufs = new_buffers()
+                node._placed = []
+                for row, entries in placed:
+                    take = []
+                    placed_rids = set()
+                    for rid, p, payload, stop in entries:
+                        if rid_origin(rid) == node.r:
+                            node._next_seq = max(
+                                node._next_seq, (rid & RID_MASK) + 1
+                            )
+                        placed_rids.add(rid)
+                        if (rid not in node.outstanding
+                                and rid not in node.payloads):
+                            node._store_payload(rid, payload, stop)
+                        place(bufs, p, row, rid, stop)
+                        take.append((rid, p))
+                    node._placed.append((row, take))
+                    # snapshot queues may hold copies of rids whose placement
+                    # is journaled after it — drop or they commit twice
+                    if row in node._queues and placed_rids:
+                        node._queues[row] = collections.deque(
+                            r for r in node._queues[row]
+                            if r not in placed_rids
+                        )
+                node._flush_mirrors()  # frames staged since the last tick
+                out, changed = run_tick(
+                    bufs, np.frombuffer(alive_b, dtype=bool)
+                )
+                node._process_outbox(out)
+                node._dirty |= changed
+                node.tick_num = tick_num + 1
+
+
 class ModeBLogger(PaxosLogger):
     def log_frame(self, payload: bytes) -> None:
         """Journal an applied replica frame (before mirror mutation; rides
@@ -103,9 +180,8 @@ def recover_modeb(cfg, member_ids, node_id, app, log_dir: str,
 
     from ..ops.tick import TickInbox
     from ..paxos.state import PaxosState
-    from ..wal.journal import read_journal
     from . import wire
-    from .manager import ModeBNode, ModeBRecord, rid_origin, RID_MASK
+    from .manager import ModeBNode, ModeBRecord
 
     logger = ModeBLogger(log_dir, native=native)
     node = ModeBNode(cfg, member_ids, node_id, app)  # no messenger, no wal
@@ -121,6 +197,8 @@ def recover_modeb(cfg, member_ids, node_id, app, log_dir: str,
         node.tick_num = meta["tick_num"]
         node._next_seq = meta["next_seq"]
         node.rows.restore(meta["rows"], meta["free_rows"])
+        for _row in meta["rows"].values():
+            node._occupied[_row] = True  # frame-target mask (anti-entropy)
         node._gid_row = {wire.gid_of(n): row for n, row in meta["rows"].items()}
         node._row_meta = dict(meta["row_meta"])
         node._stopped_rows = set(meta["stopped_rows"])
@@ -145,70 +223,25 @@ def recover_modeb(cfg, member_ids, node_id, app, log_dir: str,
             node.app.restore(name, blob)
         start_seq = snap_seq
 
-    for path in sorted(glob.glob(os.path.join(log_dir, "journal.*.log"))):
-        seq = int(os.path.basename(path).split(".")[1])
-        if seq < start_seq:
-            continue
-        for raw in read_journal(path):
-            rec = pickle.loads(raw)
-            op = rec[0]
-            if op == OP_CREATE:
-                _, name, members, epoch = rec
-                if name not in node.rows:
-                    node.create_group(name, members, epoch)
-            elif op == OP_REMOVE:
-                node.remove_group(rec[1])
-            elif op == OP_FRAME:
-                try:
-                    node._apply_frame(wire.decode_frame(rec[1]))
-                except (ValueError, IndexError):
-                    pass  # tolerate a frame torn by the crash
-            elif op == OP_CKPT:
-                _, gid, packet = rec
-                row = node._gid_row.get(gid)
-                if row is not None:
-                    node._apply_ckpt(row, packet)
-            elif op == OP_TICK:
-                _, tick_num, placed, alive_b = rec
-                if tick_num < node.tick_num:
-                    continue  # already inside the snapshot
-                req = np.zeros((node.R, node.P, node.G), np.int32)
-                stp = np.zeros((node.R, node.P, node.G), bool)
-                node._placed = []
-                for row, entries in placed:
-                    take = []
-                    placed_rids = set()
-                    for rid, p, payload, stop in entries:
-                        if rid_origin(rid) == node.r:
-                            node._next_seq = max(
-                                node._next_seq, (rid & RID_MASK) + 1
-                            )
-                        placed_rids.add(rid)
-                        if (rid not in node.outstanding
-                                and rid not in node.payloads):
-                            node._store_payload(rid, payload, stop)
-                        req[node.r, p, row] = rid
-                        stp[node.r, p, row] = stop
-                        take.append((rid, p))
-                    node._placed.append((row, take))
-                    # snapshot queues may hold copies of rids whose placement
-                    # is journaled after it — drop or they commit twice
-                    if row in node._queues and placed_rids:
-                        node._queues[row] = collections.deque(
-                            r for r in node._queues[row]
-                            if r not in placed_rids
-                        )
-                alive = np.frombuffer(alive_b, dtype=bool)
-                inbox = TickInbox(jnp.asarray(req), jnp.asarray(stp),
-                                  jnp.asarray(alive))
-                node._flush_mirrors()  # frames staged since the last tick
-                node.state, packed = node._tick_packed(node.state, inbox)
-                out, changed = unpack_node_tick(
-                    packed, node.R, node.P, node.W, node.G
-                )
-                node._process_outbox(out)
-                node._dirty |= changed
-                node.tick_num = tick_num + 1
+    def new_buffers():
+        return (np.zeros((node.R, node.P, node.G), np.int32),
+                np.zeros((node.R, node.P, node.G), bool))
+
+    def place(bufs, p, row, rid, stop):
+        bufs[0][node.r, p, row] = rid
+        bufs[1][node.r, p, row] = stop
+
+    def run_tick(bufs, alive):
+        inbox = TickInbox(jnp.asarray(bufs[0]), jnp.asarray(bufs[1]),
+                          jnp.asarray(alive))
+        node.state, packed = node._tick_packed(node.state, inbox)
+        return unpack_node_tick(packed, node.R, node.P, node.W, node.G)
+
+    replay_node_journals(
+        node, log_dir, start_seq,
+        stage=lambda raw: node._apply_frame(wire.decode_frame(raw)),
+        new_buffers=new_buffers, place=place, run_tick=run_tick,
+    )
 
     node._flush_mirrors()  # frames journaled after the last tick record
     node._held_callbacks = []  # no live clients to answer during replay

@@ -57,19 +57,20 @@ class TickDriver:
 
     def _run(self) -> None:
         drain = self.drain_ticks
-        contended = getattr(self.manager, "lock_contended", None)
+        lock = getattr(self.manager, "lock", None)
+        counted = hasattr(lock, "waiters")
         while not self._stop.is_set():
             out = self.manager.tick()
             self._first_tick.set()
             # CPython locks are unfair: without a yield window the driver
             # re-acquires manager.lock before any waiting control-plane
             # thread (propose, create, stop) gets scheduled, starving them
-            # indefinitely.  Waiters flag themselves (utils/locking.py), so
-            # the window is paid only when someone is actually waiting.
-            if contended is None:
+            # indefinitely.  Blocked acquirers register in lock.waiters
+            # (utils/locking.py), so the window is paid per tick for as long
+            # as someone is STILL waiting — not just once per flag edge.
+            if not counted:
                 time.sleep(0.0005)
-            elif contended.is_set():
-                contended.clear()
+            elif lock.waiters > 0:
                 time.sleep(0.0005)
             busy = self.manager.pending_count() > 0
             if not busy:

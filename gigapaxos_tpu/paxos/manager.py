@@ -25,7 +25,6 @@ axis exchange goes over the transport instead (net/, Mode B).
 from __future__ import annotations
 
 import collections
-import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -128,7 +127,6 @@ class PaxosManager:
         # lock serializes them (the reference synchronizes on the instance map
         # the same way, PaxosManager.java:2284-2412).
         self.lock = ContendedLock()
-        self.lock_contended = self.lock.contended
         if self.wal is not None:
             self.wal.attach(self)
 

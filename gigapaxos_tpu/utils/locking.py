@@ -13,28 +13,36 @@ import threading
 
 
 class ContendedLock:
-    """Reentrant lock that flags when an acquirer found it taken.
+    """Reentrant lock that tracks how many acquirers found it taken.
 
     CPython locks are unfair: a spinning tick driver re-acquires before any
     waiting control-plane thread (propose, create, stop) gets scheduled,
     starving them indefinitely.  The round-2 fix was an unconditional 0.5 ms
-    sleep per tick — a hard ~2k ticks/s ceiling.  Instead, waiters set
-    ``contended`` and the driver yields a window only when someone actually
-    waited (see paxos/driver.py)."""
+    sleep per tick — a hard ~2k ticks/s ceiling.  Instead, blocked acquirers
+    register in ``waiters`` and the driver yields a window per tick for as
+    long as anyone is STILL waiting (see paxos/driver.py) — a single
+    clear-once flag would let a waiter that missed its one yield window
+    starve."""
 
-    __slots__ = ("_lock", "contended")
+    __slots__ = ("_lock", "_meta", "waiters")
 
     def __init__(self):
         self._lock = threading.RLock()
-        self.contended = threading.Event()
+        self._meta = threading.Lock()  # guards the waiter count (slow path)
+        self.waiters = 0
 
     def acquire(self, blocking: bool = True, timeout: float = -1):
         if self._lock.acquire(blocking=False):
             return True
         if not blocking:
             return False
-        self.contended.set()
-        return self._lock.acquire(timeout=timeout)
+        with self._meta:
+            self.waiters += 1
+        try:
+            return self._lock.acquire(timeout=timeout)
+        finally:
+            with self._meta:
+                self.waiters -= 1
 
     def release(self) -> None:
         self._lock.release()

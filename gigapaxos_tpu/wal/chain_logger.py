@@ -49,7 +49,7 @@ def recover_chain(cfg, n_replicas: int, apps, log_dir: str, native: bool = True)
 
     from ..chain.manager import ChainManager, ChainRequest
     from ..chain.state import ChainState
-    from ..chain.tick import ChainInbox, chain_tick
+    from ..chain.tick import ChainInbox, chain_tick_packed, unpack_chain_outbox
 
     logger = ChainLogger(log_dir, native=native)
     m = ChainManager(cfg, n_replicas, apps)
@@ -62,6 +62,8 @@ def recover_chain(cfg, n_replicas: int, apps, log_dir: str, native: bool = True)
         m.state = ChainState(
             **{f: jnp.asarray(arrs[f]) for f in ChainState._fields}
         )
+        m._member_np = np.asarray(m.state.member).copy()
+        m._n_members_np = np.asarray(m.state.n_members).copy()
         m.tick_num = meta["tick_num"]
         m._next_rid = meta["next_rid"]
         m.rows.restore(meta["rows"], meta.get("free_rows"))
@@ -97,8 +99,12 @@ def recover_chain(cfg, n_replicas: int, apps, log_dir: str, native: bool = True)
         return ChainInbox(jnp.asarray(bufs[0]), jnp.asarray(bufs[1]),
                           jnp.asarray(alive))
 
+    def tick_host(state, inbox):
+        state, packed = chain_tick_packed(state, inbox)
+        return state, unpack_chain_outbox(packed, m.R, m.P, m.W, m.G)
+
     replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
-                    build_inbox, chain_tick)
+                    build_inbox, tick_host)
     logger.attach(m)
     m.wal = logger
     return m

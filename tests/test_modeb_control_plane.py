@@ -138,8 +138,10 @@ def test_migrate_preserves_state_across_processes(servers, client):
     resp = client.reconfigure("mig", new)
     assert resp["ok"], resp
     # resolution may briefly hit an RC replica that has not yet executed
-    # the complete — poll until the committed record is visible
-    deadline = time.monotonic() + 20
+    # the complete — poll until the committed record is visible (generous:
+    # the migration is several cross-process paxos commits, and the CI box
+    # runs every plane on one core)
+    deadline = time.monotonic() + 45
     got = set()
     while time.monotonic() < deadline:
         got = set(client.request_actives("mig", force=True))

@@ -586,72 +586,16 @@ class ChainModeBNode(ModeBCommon):
             del self.outstanding[rid]
 
     # ------------------------------------------------------------ frames (tx)
-    #: soft budget per encoded frame (PrepareReplyAssembler analog — see
-    #: modeb/manager.py.FRAME_BUDGET)
-    FRAME_BUDGET = 4 * 1024 * 1024
-
     def _row_wire_bytes(self) -> int:
         return (8 + 4 * len(CH_SCALARS) + 4       # gid + scalars + flags
                 + 4 * self.W * len(CH_RINGS)       # i32 rings
                 + 4 * len(CH_BITS))                # W bits -> one i32
 
     def _build_frames(self) -> List[bytes]:
-        full = self._force_full
-        if full:
-            mask = self._occupied.copy()
-        else:
-            mask = self._dirty.copy()
-            if self.anti_entropy_every > 0:
-                # rotating anti-entropy (see modeb/manager.py): per-tick 1/N
-                # occupied-row slice instead of an O(G) full-frame burst
-                mask |= self._occupied & (
-                    self._ae_phase == self.tick_num % self.anti_entropy_every
-                )
-        rows_idx = np.nonzero(mask)[0]
-        pay = []
-        for row, take in self._placed:
-            for rid, _p in take:
-                rec = self.outstanding.get(rid)
-                if rec is not None:
-                    pay.append((rid, rec.stop, rec.payload))
-                elif rid in self.payloads:
-                    pl, stop = self.payloads[rid]
-                    pay.append((rid, stop, pl))
-        if len(rows_idx) == 0 and not pay:
-            return []
-        self._force_full = False
-        self._dirty = np.zeros(self.G, bool)
-        gids = np.zeros(len(rows_idx), np.uint64)
-        for i, row in enumerate(rows_idx):
-            name = self.rows.name(int(row))
-            gids[i] = wire.gid_of(name) if name is not None else 0
-        known = gids != 0
-        rows_idx, gids = rows_idx[known], gids[known]
-        per_frame = max(1, self.FRAME_BUDGET // self._row_wire_bytes())
-        pay_chunks: List[list] = []
-        acc, acc_bytes = [], 0
-        for item in pay:
-            sz = len(item[2]) + 16
-            if acc and acc_bytes + sz > self.FRAME_BUDGET:
-                pay_chunks.append(acc)
-                acc, acc_bytes = [], 0
-            acc.append(item)
-            acc_bytes += sz
-        if acc:
-            pay_chunks.append(acc)
-        frames: List[bytes] = []
-        n_total = len(rows_idx)
-        row_chunks = [
-            (rows_idx[lo:lo + per_frame], gids[lo:lo + per_frame])
-            for lo in range(0, n_total, per_frame)
-        ] or [(rows_idx[:0], gids[:0])]
-        for ci in range(max(len(row_chunks), len(pay_chunks))):
-            chunk_rows, chunk_gids = (
-                row_chunks[ci] if ci < len(row_chunks)
-                else (rows_idx[:0], gids[:0])
-            )
-            chunk_pay = pay_chunks[ci] if ci < len(pay_chunks) else []
-            # one fused device gather + one transfer for all frame fields
+        """Fragmented chain frames for this tick (shared selection/chunking
+        in ModeBCommon; this flavor contributes the chain columns gather +
+        the chain wire schema)."""
+        def extract(chunk_rows):
             n = len(chunk_rows)
             K = max(16, 1 << max(0, int(n - 1).bit_length()))
             rpad = np.zeros(K, np.int32)
@@ -659,19 +603,20 @@ class ChainModeBNode(ModeBCommon):
             flat = chain_frame_extract(self.r, K)(
                 self.state, jnp.asarray(rpad)
             )
-            scalars, rings, bits = unpack_chain_frame_extract(
-                flat, n, K, self.W
-            )
-            self.stats["frames_sent"] += 1
-            buf = wire.encode_frame(
+            return unpack_chain_frame_extract(flat, n, K, self.W)
+
+        def encode(chunk_gids, fields, chunk_pay, full):
+            scalars, rings, bits = fields
+            return wire.encode_frame(
                 self.r, self.tick_num, self.W, chunk_gids, scalars,
-                np.zeros(n, np.int32), rings, bits, chunk_pay, full=full,
-                scalar_fields=CH_SCALARS, ring_fields=CH_RINGS,
+                np.zeros(len(chunk_gids), np.int32), rings, bits, chunk_pay,
+                full=full, scalar_fields=CH_SCALARS, ring_fields=CH_RINGS,
                 bit_fields=CH_BITS, magic=CH_MAGIC,
             )
-            self.stats["frame_bytes"] += len(buf)
-            frames.append(buf)
-        return frames
+
+        return self._build_frames_common(
+            self._row_wire_bytes(), extract, encode
+        )
 
     # ------------------------------------------------------------ frames (rx)
     def _on_frame(self, sender: str, payload: bytes) -> None:

@@ -50,6 +50,11 @@ class PaxosTuning:
     # only — the paused set is then bounded by host memory).
     spill_dir: str = ""
     spill_cache: int = 4096
+    # Pipelined ticks (SURVEY §2.2 item 3, the BatchedLogger/RequestBatcher
+    # stage overlap): process tick N-1's decision stream (host app
+    # execution) while the device computes tick N and the WAL drains.
+    # Costs one tick of response latency; checkpoints drain synchronously.
+    pipeline_ticks: bool = False
 
     def __post_init__(self) -> None:
         if self.window < 2 or (self.window & (self.window - 1)):

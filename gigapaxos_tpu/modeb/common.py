@@ -112,6 +112,99 @@ class ModeBCommon:
         if self.on_work is not None:
             self.on_work()
 
+    # ------------------------------------------------------------ frames (tx)
+    #: soft budget per encoded frame; a full-state frame over a huge group
+    #: population (or a tick placing large client payloads) fragments into
+    #: several frames under this size instead of tripping transport
+    #: MAX_FRAME (the PrepareReplyAssembler analog,
+    #: gigapaxos/paxosutil/PrepareReplyAssembler.java:1-224)
+    FRAME_BUDGET = 4 * 1024 * 1024
+
+    def _frame_mask_and_payloads(self):
+        """Select which group rows and payloads this tick's frames carry:
+        dirty rows + the rotating anti-entropy slice (or every occupied row
+        after a sync request), plus every payload placed this tick."""
+        full = self._force_full
+        if full:
+            mask = self._occupied.copy()
+        else:
+            mask = self._dirty.copy()
+            if self.anti_entropy_every > 0:
+                # rotating anti-entropy: each tick re-ships the 1/N slice of
+                # occupied rows with row % N == tick % N — the same per-row
+                # refresh period as an every-N-ticks full frame, without the
+                # O(G) burst
+                mask |= self._occupied & (
+                    self._ae_phase == self.tick_num % self.anti_entropy_every
+                )
+        pay = []
+        for row, take in self._placed:
+            for rid, _p in take:
+                rec = self.outstanding.get(rid)
+                if rec is not None:
+                    pay.append((rid, rec.stop, rec.payload))
+                elif rid in self.payloads:
+                    pl, stop = self.payloads[rid]
+                    pay.append((rid, stop, pl))
+        return full, mask, pay
+
+    def _build_frames_common(self, row_wire_bytes: int, extract, encode):
+        """Shared fragmentation loop for both protocol flavors.
+
+        ``extract(chunk_rows) -> fields`` gathers the frame columns for one
+        chunk (one fused device program); ``encode(gids, fields, pay, full)
+        -> bytes`` runs the wire codec.  Rows and payloads are chunked
+        separately against FRAME_BUDGET, so each emitted frame is bounded by
+        ~2x budget (a single oversized payload still ships alone; truly
+        huge blobs belong on the net/bulk.py out-of-band path)."""
+        import numpy as np
+
+        from . import wire
+
+        full, mask, pay = self._frame_mask_and_payloads()
+        rows_idx = np.nonzero(mask)[0]
+        if len(rows_idx) == 0 and not pay:
+            return []
+        self._force_full = False
+        self._dirty = np.zeros(self.G, bool)
+        gids = np.zeros(len(rows_idx), np.uint64)
+        for i, row in enumerate(rows_idx):
+            name = self.rows.name(int(row))
+            gids[i] = wire.gid_of(name) if name is not None else 0
+        known = gids != 0
+        rows_idx, gids = rows_idx[known], gids[known]
+        per_frame = max(1, self.FRAME_BUDGET // row_wire_bytes)
+        pay_chunks: list = []
+        acc, acc_bytes = [], 0
+        for item in pay:
+            sz = len(item[2]) + 16
+            if acc and acc_bytes + sz > self.FRAME_BUDGET:
+                pay_chunks.append(acc)
+                acc, acc_bytes = [], 0
+            acc.append(item)
+            acc_bytes += sz
+        if acc:
+            pay_chunks.append(acc)
+        frames: list = []
+        n_total = len(rows_idx)
+        row_chunks = [
+            (rows_idx[lo:lo + per_frame], gids[lo:lo + per_frame])
+            for lo in range(0, n_total, per_frame)
+        ] or [(rows_idx[:0], gids[:0])]
+        for ci in range(max(len(row_chunks), len(pay_chunks))):
+            chunk_rows, chunk_gids = (
+                row_chunks[ci] if ci < len(row_chunks)
+                else (rows_idx[:0], gids[:0])
+            )
+            chunk_pay = pay_chunks[ci] if ci < len(pay_chunks) else []
+            fields = extract(chunk_rows)
+            buf = encode(chunk_gids, fields, chunk_pay, full)
+            self.stats["frames_sent"] += 1
+            self.stats["frame_groups"] += len(chunk_rows)
+            self.stats["frame_bytes"] += len(buf)
+            frames.append(buf)
+        return frames
+
     # -------------------------------------------------------------- mirrors
     def _purge_staged_row(self, row: int) -> None:
         """Drop staged mirror-frame entries targeting a freed row: their row

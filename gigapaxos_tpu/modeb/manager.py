@@ -130,6 +130,8 @@ class ModeBNode(ModeBCommon):
         self._tainted_rows: set = set()
         self._force_full = True  # first frame announces full own row
         self._placed: list = []
+        #: pipelined mode: (outbox, placed) of the last dispatched tick
+        self._pending_out = None
         self._pending_whois: set = set()
         #: decoded frames awaiting the once-per-tick fused mirror apply:
         #: (sender_r, local_rows, frame_row_selector, Frame)
@@ -204,6 +206,19 @@ class ModeBNode(ModeBCommon):
             row = self.rows.row(name)
             if row is None:
                 return False
+            # complete a pipelined pending outbox before the row is freed
+            # (and possibly recycled): its requeues/decisions must resolve
+            # against the OLD name<->row mapping
+            self.drain_pipeline()
+            # fail still-outstanding requests of the dying group so their
+            # rids can never be re-placed onto a future occupant of the row
+            gone = [rid for rid, rec in self.outstanding.items()
+                    if rec.row == row]
+            for rid in gone:
+                rec = self.outstanding.pop(rid)
+                if rec.callback is not None and not rec.responded:
+                    rec.responded = True
+                    self._held_callbacks.append((rec.callback, rid, None))
             self.state = st.free_groups(self.state, np.array([row], np.int32))
             self.rows.free(name)
             self._gid_row.pop(wire.gid_of(name), None)
@@ -325,26 +340,40 @@ class ModeBNode(ModeBCommon):
             self._refresh_alive()
             self._flush_mirrors()
             inbox = self._build_inbox()
+            placed = self._placed
             # dispatch first, journal second: the WAL append+fsync overlaps
             # the async device step (BatchedLogger overlap, SURVEY §2.2
             # item 3); responses stay held until is_synced()
             self.state, packed = self._tick_packed(self.state, inbox)
             if self.wal is not None:
                 self.wal.log_inbox(self.tick_num, inbox)
-            out, changed = unpack_node_tick(
-                packed, self.R, self.P, self.W, self.G
-            )
-            self._process_outbox(out)
-            self._dirty |= changed
             self.tick_num += 1
+            if self.cfg.paxos.pipeline_ticks:
+                # stage-3 overlap: execute the PREVIOUS tick's decision
+                # stream while the device computes this one
+                if self._pending_out is not None:
+                    p_out, p_placed = self._pending_out
+                    self._pending_out = None  # callbacks may re-enter a
+                    # drain path; never double-process
+                    self._complete_tick(p_out, p_placed)
+                out, changed = unpack_node_tick(
+                    packed, self.R, self.P, self.W, self.G
+                )
+                self._pending_out = (out, placed)
+                self._dirty |= changed
+                if self.wal is not None and self.wal.checkpoint_due():
+                    # the snapshot's host metadata must cover every tick the
+                    # device state contains — drain the one-tick pipeline
+                    self.drain_pipeline()
+            else:
+                out, changed = unpack_node_tick(
+                    packed, self.R, self.P, self.W, self.G
+                )
+                self._dirty |= changed
+                self._complete_tick(out, placed)
             frames = self._build_frames()
             if self.wal is not None:
                 self.wal.maybe_checkpoint()
-            self._flush_callbacks()
-            if self.tick_num % 16 == 0 or self._tainted_rows:
-                self._check_laggard(out)
-            if self.tick_num % 64 == 0:
-                self._sweep()
         if frames and self.m is not None:
             for i, peer in enumerate(self.members):
                 if i != self.r:
@@ -405,10 +434,28 @@ class ModeBNode(ModeBCommon):
         # build; zero-copy dispatch aliasing them would race the async step)
         return TickInbox(req.copy(), stp.copy(), self.alive.copy())
 
-    def _process_outbox(self, out) -> None:
+    def _complete_tick(self, out, placed: list) -> None:
+        """Consume one tick's outbox: requeue rejected intake, execute the
+        decision stream, release durable callbacks, periodic repair/GC."""
+        self._process_outbox(out, placed)
+        self._flush_callbacks()
+        if self.tick_num % 16 == 0 or self._tainted_rows:
+            self._check_laggard(out)
+        if self.tick_num % 64 == 0:
+            self._sweep()
+
+    def drain_pipeline(self) -> None:
+        """Synchronously finish the pending pipelined outbox."""
+        with self.lock:
+            if self._pending_out is not None:
+                p_out, p_placed = self._pending_out
+                self._pending_out = None
+                self._complete_tick(p_out, p_placed)
+
+    def _process_outbox(self, out, placed=None) -> None:
         self._coord_view = out.coord_id
         taken = out.intake_taken[self.r]  # [P, G]
-        for row, take in self._placed:
+        for row, take in (self._placed if placed is None else placed):
             # intake only really happened if WE were the winning coordinator;
             # a write into a peer's mirror ring was discarded by the kernel
             ours = int(self._coord_view[row]) == self.r
@@ -489,13 +536,6 @@ class ModeBNode(ModeBCommon):
             del self.outstanding[rid]
 
     # ------------------------------------------------------------ frames (tx)
-    #: soft budget per encoded frame; a full-state frame over a huge group
-    #: population fragments into several frames under this size instead of
-    #: tripping transport MAX_FRAME (the PrepareReplyAssembler analog,
-    #: gigapaxos/paxosutil/PrepareReplyAssembler.java:1-224 — fragmentation
-    #: of oversized replica state under MAX_PAYLOAD_SIZE)
-    FRAME_BUDGET = 4 * 1024 * 1024
-
     def _row_wire_bytes(self) -> int:
         """Encoded bytes one group row contributes to a frame."""
         return (8 + 4 * len(wire.SCALARS) + 4                  # gid+scalars+flags
@@ -503,70 +543,10 @@ class ModeBNode(ModeBCommon):
                 + 4 * len(wire.RING_BITS))                     # W bits -> i32
 
     def _build_frames(self) -> List[bytes]:
-        full = self._force_full
-        if full:
-            mask = self._occupied.copy()
-        else:
-            mask = self._dirty.copy()
-            if self.anti_entropy_every > 0:
-                # rotating anti-entropy: each tick re-ships the 1/N slice of
-                # occupied rows with row % N == tick % N — the same per-row
-                # refresh period as the old every-N-ticks full frame, without
-                # the O(G) burst (VERDICT r2: "O(G) traffic forever,
-                # unexamined at G=100k")
-                mask |= self._occupied & (
-                    self._ae_phase == self.tick_num % self.anti_entropy_every
-                )
-        rows_idx = np.nonzero(mask)[0]
-        # newly placed payloads always ship, even if nothing else changed
-        pay = []
-        for row, take in self._placed:
-            for rid, _p in take:
-                rec = self.outstanding.get(rid)
-                if rec is not None:
-                    pay.append((rid, rec.stop, rec.payload))
-                elif rid in self.payloads:
-                    pl, stop = self.payloads[rid]
-                    pay.append((rid, stop, pl))
-        if len(rows_idx) == 0 and not pay:
-            return []
-        self._force_full = False
-        self._dirty = np.zeros(self.G, bool)
-        gids = np.zeros(len(rows_idx), np.uint64)
-        for i, row in enumerate(rows_idx):
-            name = self.rows.name(int(row))
-            gids[i] = wire.gid_of(name) if name is not None else 0
-        known = gids != 0
-        rows_idx, gids = rows_idx[known], gids[known]
-        per_frame = max(1, self.FRAME_BUDGET // self._row_wire_bytes())
-        # payloads count against the budget too (a tick can place P large
-        # client blobs): greedily split them so no chunk's payload section
-        # exceeds the budget — each frame is then bounded by ~2x budget
-        # (one oversized single payload still ships alone; truly huge blobs
-        # belong on the net/bulk.py out-of-band path)
-        pay_chunks: List[list] = []
-        acc, acc_bytes = [], 0
-        for item in pay:
-            sz = len(item[2]) + 16
-            if acc and acc_bytes + sz > self.FRAME_BUDGET:
-                pay_chunks.append(acc)
-                acc, acc_bytes = [], 0
-            acc.append(item)
-            acc_bytes += sz
-        if acc:
-            pay_chunks.append(acc)
-        frames: List[bytes] = []
-        n_total = len(rows_idx)
-        row_chunks = [
-            (rows_idx[lo:lo + per_frame], gids[lo:lo + per_frame])
-            for lo in range(0, n_total, per_frame)
-        ] or [(rows_idx[:0], gids[:0])]
-        for ci in range(max(len(row_chunks), len(pay_chunks))):
-            chunk_rows, chunk_gids = (
-                row_chunks[ci] if ci < len(row_chunks)
-                else (rows_idx[:0], gids[:0])
-            )
-            chunk_pay = pay_chunks[ci] if ci < len(pay_chunks) else []
+        """Fragmented replica frames for this tick (the shared selection /
+        chunking loop lives in ModeBCommon; this flavor contributes the
+        fused device gather of the paxos frame columns + the wire schema)."""
+        def extract(chunk_rows):
             # one fused device gather + one transfer for all ~21 frame
             # fields (the round-2 path paid a dispatch+sync per field)
             n = len(chunk_rows)
@@ -574,18 +554,18 @@ class ModeBNode(ModeBCommon):
             rpad = np.zeros(K, np.int32)
             rpad[:n] = chunk_rows
             flat = frame_extract(self.r, K)(self.state, jnp.asarray(rpad))
-            scalars, flags, rings, ring_bits = unpack_frame_extract(
-                flat, n, K, self.W
-            )
-            self.stats["frames_sent"] += 1
-            self.stats["frame_groups"] += n
-            buf = wire.encode_frame(
+            return unpack_frame_extract(flat, n, K, self.W)
+
+        def encode(chunk_gids, fields, chunk_pay, full):
+            scalars, flags, rings, ring_bits = fields
+            return wire.encode_frame(
                 self.r, self.tick_num, self.W, chunk_gids, scalars, flags,
                 rings, ring_bits, chunk_pay, full=full,
             )
-            self.stats["frame_bytes"] += len(buf)
-            frames.append(buf)
-        return frames
+
+        return self._build_frames_common(
+            self._row_wire_bytes(), extract, encode
+        )
 
     # ------------------------------------------------------------ frames (rx)
     def _on_frame(self, sender: str, payload: bytes) -> None:
@@ -801,6 +781,8 @@ class ModeBNode(ModeBCommon):
             n = sum(len(q) for q in self._queues.values())
             n += sum(1 for rec in self.outstanding.values()
                      if not rec.responded)
+            if self._pending_out is not None:
+                n += 1  # a pipelined outbox still needs a tick to complete
             # keep ticking while replica traffic is flowing, even with no
             # local work: mirror updates only turn into decisions via ticks
             if self.tick_num - self._last_frame_rx < 8:

@@ -1,0 +1,194 @@
+"""Pallas TPU kernel for the ring-window plane gather.
+
+``ops/window.gather_planes`` (the in-order delivery / tally alignment
+primitive — ``PaxosAcceptor.putAndRemoveNextExecutable``'s ring read) is the
+tick's hottest op: the XLA one-hot formulation materializes
+``[..., J, Wp, G]`` broadcast temporaries in HBM, which at the BASELINE
+configuration (W=8, G=1M) is ~768 MB per gather and ~10 gathers per tick —
+measured 356 ms/tick, >99% of the whole fused step, scaling with W².
+
+This kernel performs the same per-lane permutation entirely in VMEM: each
+grid step loads one ``[Wp, Gb]`` tile and its ``[J, Gb]`` index tile, emits
+``out[j, g] = arr[idx[j, g], g]`` via an unrolled Wp-way select on
+registers, and writes ``[J, Gb]`` back — HBM traffic is exactly one read of
+``arr`` + ``idx`` and one write of ``out`` (the W² work stays on the VPU).
+
+Used automatically by the fused ticks when running on a TPU backend
+(``use_pallas_gather()``); the one-hot XLA path remains the portable
+fallback (CPU tests, interpret mode) and the semantic reference
+(``tests/test_pallas_gather.py`` checks them against each other).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _kernel(arr_ref, idx_ref, out_ref, *, wp: int, j_out: int):
+    # arr [1, Wp, Gb]; idx [J, Gb]; out [1, J, Gb]
+    for j in range(j_out):
+        sel = idx_ref[j, :]
+        acc = jnp.zeros_like(out_ref[0, j, :])
+        for i in range(wp):
+            acc = jnp.where(sel == i, arr_ref[0, i, :], acc)
+        out_ref[0, j, :] = acc
+
+
+@functools.lru_cache(maxsize=None)
+def _build(lead: int, wp: int, j_out: int, g: int, dtype_name: str,
+           interpret: bool):
+    from jax.experimental import pallas as pl
+
+    dtype = jnp.dtype(dtype_name)
+    # largest power-of-two-times-128 divisor of g, capped at 4096 lanes
+    # (callers only guarantee g % 128 == 0 — e.g. max_groups = 4224)
+    gb = math.gcd(g, 4096)
+
+    kern = functools.partial(_kernel, wp=wp, j_out=j_out)
+    grid = (lead, g // gb)
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((lead, j_out, g), dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, wp, gb), lambda l, b: (l, 0, b)),
+            pl.BlockSpec((j_out, gb), lambda l, b: (0, b)),
+        ],
+        out_specs=pl.BlockSpec((1, j_out, gb), lambda l, b: (l, 0, b)),
+        interpret=interpret,
+    )
+
+
+def gather_planes_pallas(arr, idx, interpret: bool = False):
+    """Drop-in for ``window.gather_planes`` on TPU.
+
+    ``arr``: ``[..., Wp, G]``; ``idx``: ``[J, G]`` (shared across leading
+    dims) or ``[..., J, G]``.  Lanes G must be a multiple of 128.
+    """
+    wp, g = arr.shape[-2], arr.shape[-1]
+    j_out = idx.shape[-2]
+    lead_shape = arr.shape[:-2]
+    lead = int(np.prod(lead_shape)) if lead_shape else 1
+    # bool/i8 tiles hit Mosaic's narrow-dtype tiling constraints; gather in
+    # i32 and cast back (the arrays this feeds are i32-dominated anyway)
+    squeeze_bool = arr.dtype == jnp.bool_
+    a = arr.astype(jnp.int32) if squeeze_bool else arr
+    a = a.reshape(lead, wp, g)
+    if idx.ndim > 2:
+        # per-lead indices: flatten into the lead axis pairing
+        ix = idx.reshape(lead, j_out, g).astype(jnp.int32)
+        out = _build_perlead(lead, wp, j_out, g, str(a.dtype), interpret)(
+            a, ix
+        )
+    else:
+        ix = idx.astype(jnp.int32)
+        out = _build(lead, wp, j_out, g, str(a.dtype), interpret)(a, ix)
+    out = out.reshape(*lead_shape, j_out, g)
+    return out.astype(jnp.bool_) if squeeze_bool else out
+
+
+def _kernel_perlead(arr_ref, idx_ref, out_ref, *, wp: int, j_out: int):
+    for j in range(j_out):
+        sel = idx_ref[0, j, :]
+        acc = jnp.zeros_like(out_ref[0, j, :])
+        for i in range(wp):
+            acc = jnp.where(sel == i, arr_ref[0, i, :], acc)
+        out_ref[0, j, :] = acc
+
+
+@functools.lru_cache(maxsize=None)
+def _build_perlead(lead: int, wp: int, j_out: int, g: int, dtype_name: str,
+                   interpret: bool):
+    from jax.experimental import pallas as pl
+
+    dtype = jnp.dtype(dtype_name)
+    # largest power-of-two-times-128 divisor of g, capped at 4096 lanes
+    # (callers only guarantee g % 128 == 0 — e.g. max_groups = 4224)
+    gb = math.gcd(g, 4096)
+
+    kern = functools.partial(_kernel_perlead, wp=wp, j_out=j_out)
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((lead, j_out, g), dtype),
+        grid=(lead, g // gb),
+        in_specs=[
+            pl.BlockSpec((1, wp, gb), lambda l, b: (l, 0, b)),
+            pl.BlockSpec((1, j_out, gb), lambda l, b: (l, 0, b)),
+        ],
+        out_specs=pl.BlockSpec((1, j_out, gb), lambda l, b: (l, 0, b)),
+        interpret=interpret,
+    )
+
+
+def _kernel_match(vals_ref, keys_ref, idx_ref, out_ref, *, e_planes: int,
+                  j_out: int):
+    # vals/keys [E, Gb]; idx [J, Gb]; out [J, Gb]
+    for j in range(j_out):
+        want = idx_ref[j, :]
+        acc = jnp.zeros_like(out_ref[j, :])
+        for e in range(e_planes):
+            acc = jnp.where(keys_ref[e, :] == want, vals_ref[e, :], acc)
+        out_ref[j, :] = acc
+
+
+@functools.lru_cache(maxsize=None)
+def _build_match(e_planes: int, j_out: int, g: int, dtype_name: str,
+                 interpret: bool):
+    from jax.experimental import pallas as pl
+
+    dtype = jnp.dtype(dtype_name)
+    # largest power-of-two-times-128 divisor of g, capped at 4096 lanes
+    # (callers only guarantee g % 128 == 0 — e.g. max_groups = 4224)
+    gb = math.gcd(g, 4096)
+    kern = functools.partial(_kernel_match, e_planes=e_planes, j_out=j_out)
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((j_out, g), dtype),
+        grid=(g // gb,),
+        in_specs=[
+            pl.BlockSpec((e_planes, gb), lambda b: (0, b)),
+            pl.BlockSpec((e_planes, gb), lambda b: (0, b)),
+            pl.BlockSpec((j_out, gb), lambda b: (0, b)),
+        ],
+        out_specs=pl.BlockSpec((j_out, gb), lambda b: (0, b)),
+        interpret=interpret,
+    )
+
+
+def match_planes_pallas(vals, keys, idx, interpret: bool = False):
+    """Per-lane key-match select (see window.match_planes): ``vals``/``keys``
+    ``[E, G]``, ``idx`` ``[J, G]`` -> ``[J, G]``."""
+    e_planes, g = vals.shape
+    j_out = idx.shape[0]
+    squeeze_bool = vals.dtype == jnp.bool_
+    v = vals.astype(jnp.int32) if squeeze_bool else vals
+    out = _build_match(e_planes, j_out, g, str(v.dtype), interpret)(
+        v, keys.astype(jnp.int32), idx.astype(jnp.int32)
+    )
+    return out.astype(jnp.bool_) if squeeze_bool else out
+
+
+@functools.lru_cache(maxsize=1)
+def use_pallas_gather() -> bool:
+    """True when the fused ticks should route plane gathers through the
+    pallas kernel: TPU-class default backend, single device (under GSPMD a
+    pallas custom call without a sharding rule would replicate its [R, W, G]
+    operands across the mesh — the sharded path keeps the XLA select chain,
+    whose replica-axis reductions lower to ICI collectives).  Overrides:
+    GPTPU_NO_PALLAS=1 forces off, GPTPU_PALLAS=1 forces on."""
+    if os.environ.get("GPTPU_NO_PALLAS"):
+        return False
+    if os.environ.get("GPTPU_PALLAS"):
+        return True
+    try:
+        backend = jax.default_backend()
+        n_dev = len(jax.devices())
+    except Exception:
+        return False
+    return backend in ("tpu", "axon") and n_dev == 1

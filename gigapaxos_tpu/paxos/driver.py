@@ -54,6 +54,11 @@ class TickDriver:
         self._stop.set()
         self._kick.set()
         self._thread.join(timeout=10)
+        # a pipelined manager may hold one final unprocessed outbox whose
+        # callbacks clients are still waiting on
+        drain = getattr(self.manager, "drain_pipeline", None)
+        if drain is not None:
+            drain()
 
     def _run(self) -> None:
         drain = self.drain_ticks

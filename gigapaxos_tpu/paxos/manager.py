@@ -122,6 +122,9 @@ class PaxosManager:
         self._in_req = np.zeros((self.R, self.P, self.G), np.int32)
         self._in_stp = np.zeros((self.R, self.P, self.G), bool)
         self._placed: list = []
+        #: pipelined mode: (outbox, placed) of the last dispatched tick,
+        #: consumed at the start of the next (SURVEY §2.2 item 3)
+        self._pending_out = None
         # Control-plane threads (messenger readers, protocol tasks) call the
         # admin/propose API while a tick driver loops on tick(); one reentrant
         # lock serializes them (the reference synchronizes on the instance map
@@ -169,6 +172,11 @@ class PaxosManager:
         row = self.rows.row(name)
         if row is None:
             return False
+        # a pipelined pending outbox may still reference this row under its
+        # OLD name<->row mapping; complete it before the row is freed (and
+        # possibly recycled) so stale placements/decisions cannot resolve
+        # against a future occupant
+        self.drain_pipeline()
         self.state = st.free_groups(self.state, np.array([row], np.int32))
         self._member_np[:, row] = False
         self._n_members_np[row] = 0
@@ -237,6 +245,10 @@ class PaxosManager:
         return len(self._pause_eligible(limit=limit, ignore_idle=False))
 
     def _pause_eligible(self, limit: int, ignore_idle: bool) -> List[str]:
+        # quiescence is judged against host bookkeeping — complete any
+        # pipelined pending outbox first so the judgment is current (and no
+        # stale placement can target a row this call is about to free)
+        self.drain_pipeline()
         idle_after = 0 if ignore_idle else self.cfg.paxos.deactivation_ticks
         exec_slot = np.array(self.state.exec_slot)
         next_slot = np.array(self.state.next_slot)
@@ -431,6 +443,7 @@ class PaxosManager:
     @_locked
     def tick(self) -> HostOutbox:
         inbox = self._build_inbox()
+        placed = self._placed
         # dispatch first, journal second: the jitted step runs asynchronously
         # while the WAL appends+fsyncs this tick's record (SURVEY §2.2 item 3,
         # the BatchedLogger overlap, AbstractPaxosLogger.java:99-107).  Safe
@@ -438,11 +451,33 @@ class PaxosManager:
         self.state, packed = paxos_tick_packed(self.state, inbox, -1)
         if self.wal is not None:
             self.wal.log_inbox(self.tick_num, inbox)
-        out = unpack_outbox(packed, self.R, self.P, self.W, self.G)  # syncs
-        self._process_outbox(out)
         self.tick_num += 1
+        if self.cfg.paxos.pipeline_ticks:
+            # stage 3 of the overlap: execute the PREVIOUS tick's decision
+            # stream (host app work) while the device computes this one —
+            # ingest N+1 / device N / app-exec+WAL N-1 all concurrent
+            if self._pending_out is not None:
+                p_out, p_placed = self._pending_out
+                self._pending_out = None  # before completing: _complete_tick
+                # may reach drain_pipeline (pause_idle) — must not re-enter
+                self._complete_tick(p_out, p_placed)
+            out = unpack_outbox(packed, self.R, self.P, self.W, self.G)
+            self._pending_out = (out, placed)
+            # a due checkpoint must cover on-host effects of every tick the
+            # device state contains — drain the one-tick pipeline first
+            if self.wal is not None and self.wal.checkpoint_due():
+                self.drain_pipeline()
+        else:
+            out = unpack_outbox(packed, self.R, self.P, self.W, self.G)
+            self._complete_tick(out, placed)
         if self.wal is not None:
             self.wal.maybe_checkpoint()
+        return out
+
+    def _complete_tick(self, out: HostOutbox, placed: list) -> None:
+        """Consume one tick's outbox: requeue rejected intake, execute the
+        ordered decision stream, release durable callbacks, periodic GC."""
+        self._process_outbox(out, placed)
         self._flush_callbacks()
         if self.tick_num % 64 == 0:
             self._sweep_outstanding()
@@ -452,7 +487,15 @@ class PaxosManager:
             and len(self.rows) > 0
         ):
             self.pause_idle()
-        return out
+
+    @_locked
+    def drain_pipeline(self) -> None:
+        """Synchronously finish the pending pipelined outbox (no-op when
+        nothing is pending or pipelining is off)."""
+        if self._pending_out is not None:
+            p_out, p_placed = self._pending_out
+            self._pending_out = None
+            self._complete_tick(p_out, p_placed)
 
     def _flush_callbacks(self) -> None:
         """Release client responses only once the WAL covering their tick is
@@ -466,9 +509,9 @@ class PaxosManager:
         for cb, rid, resp in held:
             cb(rid, resp)
 
-    def _process_outbox(self, out: HostOutbox) -> None:
+    def _process_outbox(self, out: HostOutbox, placed=None) -> None:
         taken = out.intake_taken
-        for row, take in self._placed:
+        for row, take in (self._placed if placed is None else placed):
             for rid, entry, p in reversed(take):
                 if not taken[entry, p, row] and rid in self.outstanding:
                     self._queues[row].appendleft(rid)  # retry next tick
@@ -596,4 +639,7 @@ class PaxosManager:
 
     @_locked
     def pending_count(self) -> int:
-        return sum(len(q) for q in self._queues.values())
+        n = sum(len(q) for q in self._queues.values())
+        if self._pending_out is not None:
+            n += 1  # a pipelined outbox still needs a tick to complete
+        return n

@@ -84,6 +84,7 @@ def make_loopback_cluster(
     ``n_groups`` pre-created names g0..g{n-1} on 3 replicas."""
     cfg = GigapaxosTpuConfig()
     cfg.paxos.max_groups = max_groups or max(64, n_groups)
+    cfg.paxos.pipeline_ticks = True  # stage-overlap on the probe clusters
     for i in range(n_actives):
         cfg.nodes.actives[f"AR{i}"] = ("127.0.0.1", 0)
     for i in range(n_rc):

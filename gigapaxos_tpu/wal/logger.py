@@ -135,6 +135,13 @@ class PaxosLogger:
         holds client responses until this is true)."""
         return self._ticks_since_sync == 0
 
+    def checkpoint_due(self) -> bool:
+        """True when the next maybe_checkpoint() will snapshot — pipelined
+        managers drain their pending outbox first so the snapshot's host
+        metadata (app state, dedup, queues) covers every tick the device
+        state does."""
+        return self._ticks_since_ckpt + 1 >= self.checkpoint_every
+
     def maybe_checkpoint(self) -> None:
         """Called by the manager *after* a tick completes (so the snapshot
         covers it and the rolled journal starts at the next tick; rolling

@@ -88,6 +88,9 @@ def run_bench() -> dict:
 
     use_scan = bool(os.environ.get("GPTPU_BENCH_SCAN"))
 
+    # ONE per-tick body shared by both drivers (eager dispatch queue and
+    # on-device lax.scan) so the two paths cannot measure different
+    # workloads.  carry is a tuple: (state, acc) or (state, kv, acc).
     if device_app:
         from gigapaxos_tpu.models.device_kv import (OP_PUT, fused_step,
                                                     init_kv,
@@ -95,94 +98,57 @@ def run_bench() -> dict:
 
         slots = 8
         table = 1 << max(16, (4 * G - 1).bit_length())
-        kv = init_kv(R, G, slots=slots, table=table)
+        kv0 = init_kv(R, G, slots=slots, table=table)
+        carry0 = (state, kv0, jnp.int32(0))
 
-        def run_n(state, kv, base):
-            def body(carry, i):
-                state, kv, acc = carry
-                inbox, rids = make_inbox(base + i * G)
-                g = jnp.arange(G, dtype=jnp.int32)
-                # synthetic KV workload (the TESTPaxosApp state-update
-                # analog): PUT key (g & slots-1) = rid, registered on-device
-                kv = register_requests(
-                    kv, rids, jnp.full(G, OP_PUT, jnp.int32),
-                    jnp.bitwise_and(g, slots - 1) + 1, rids,
-                )
-                state, kv, out, _resp, _miss = fused_step(state, kv, inbox)
-                return (state, kv, acc + jnp.sum(out.decided_now)), None
-
-            (state, kv, acc), _ = lax.scan(
-                body, (state, kv, jnp.int32(0)),
-                jnp.arange(n_ticks, dtype=jnp.int32),
+        def tick_once(carry, rid_base):
+            state, kv, acc = carry
+            inbox, rids = make_inbox(rid_base)
+            g = jnp.arange(G, dtype=jnp.int32)
+            # synthetic KV workload (the TESTPaxosApp state-update analog):
+            # PUT key (g & slots-1) = rid, descriptors registered on-device
+            kv = register_requests(
+                kv, rids, jnp.full(G, OP_PUT, jnp.int32),
+                jnp.bitwise_and(g, slots - 1) + 1, rids,
             )
-            return state, kv, acc
-
-        if use_scan:
-            run_j = jax.jit(run_n, donate_argnums=(0, 1))
-            state, kv, acc = run_j(state, kv, jnp.int32(1))  # compile + warm
-            jax.block_until_ready(acc)
-            t0 = time.perf_counter()
-            state, kv, acc = run_j(state, kv, jnp.int32(1 + n_ticks * G))
-            total_decisions = int(acc)  # blocks until the scan completes
-            dt = time.perf_counter() - t0
-        else:
-            def step_acc(state, kv, acc, rid_base):
-                inbox, rids = make_inbox(rid_base)
-                g = jnp.arange(G, dtype=jnp.int32)
-                kv = register_requests(
-                    kv, rids, jnp.full(G, OP_PUT, jnp.int32),
-                    jnp.bitwise_and(g, slots - 1) + 1, rids,
-                )
-                state, kv, out, _resp, _miss = fused_step(state, kv, inbox)
-                return state, kv, acc + jnp.sum(out.decided_now)
-
-            step_j = jax.jit(step_acc, donate_argnums=(0, 1, 2))
-            state, kv, acc = step_j(state, kv, jnp.int32(0), jnp.int32(1))
-            jax.block_until_ready(acc)
-            acc = jnp.int32(0)
-            t0 = time.perf_counter()
-            for i in range(n_ticks):
-                state, kv, acc = step_j(state, kv, acc,
-                                        jnp.int32(1 + (i + 1) * G))
-            total_decisions = int(acc)  # blocks on the queued ticks
-            dt = time.perf_counter() - t0
+            state, kv, out, _resp, _miss = fused_step(state, kv, inbox)
+            return (state, kv, acc + jnp.sum(out.decided_now))
     else:
-        def run_n(state, base):
+        carry0 = (state, jnp.int32(0))
+
+        def tick_once(carry, rid_base):
+            state, acc = carry
+            inbox, _rids = make_inbox(rid_base)
+            new_state, out = paxos_tick_impl(state, inbox)
+            return (new_state, acc + jnp.sum(out.decided_now))
+
+    if use_scan:
+        def run_n(carry, base):
             def body(carry, i):
-                state, acc = carry
-                inbox, _rids = make_inbox(base + i * G)
-                new_state, out = paxos_tick_impl(state, inbox)
-                return (new_state, acc + jnp.sum(out.decided_now)), None
+                return tick_once(carry, base + i * G), None
 
-            (state, acc), _ = lax.scan(
-                body, (state, jnp.int32(0)),
-                jnp.arange(n_ticks, dtype=jnp.int32),
+            carry, _ = lax.scan(
+                body, carry, jnp.arange(n_ticks, dtype=jnp.int32)
             )
-            return state, acc
+            return carry
 
-        if use_scan:
-            run_j = jax.jit(run_n, donate_argnums=(0,))
-            state, acc = run_j(state, jnp.int32(1))  # compile + warm
-            jax.block_until_ready(acc)
-            t0 = time.perf_counter()
-            state, acc = run_j(state, jnp.int32(1 + n_ticks * G))
-            total_decisions = int(acc)  # blocks until the scan completes
-            dt = time.perf_counter() - t0
-        else:
-            def step_acc(state, acc, rid_base):
-                inbox, _rids = make_inbox(rid_base)
-                new_state, out = paxos_tick_impl(state, inbox)
-                return new_state, acc + jnp.sum(out.decided_now)
-
-            step_j = jax.jit(step_acc, donate_argnums=(0, 1))
-            state, acc = step_j(state, jnp.int32(0), jnp.int32(1))
-            jax.block_until_ready(acc)
-            acc = jnp.int32(0)
-            t0 = time.perf_counter()
-            for i in range(n_ticks):
-                state, acc = step_j(state, acc, jnp.int32(1 + (i + 1) * G))
-            total_decisions = int(acc)  # blocks on the queued ticks
-            dt = time.perf_counter() - t0
+        run_j = jax.jit(run_n, donate_argnums=(0,))
+        carry = run_j(carry0, jnp.int32(1))  # compile + warm
+        jax.block_until_ready(carry[-1])
+        t0 = time.perf_counter()
+        carry = run_j(carry, jnp.int32(1 + n_ticks * G))
+        total_decisions = int(carry[-1])  # blocks until the scan completes
+        dt = time.perf_counter() - t0
+    else:
+        step_j = jax.jit(tick_once, donate_argnums=(0,))
+        carry = step_j(carry0, jnp.int32(1))  # compile + warm
+        jax.block_until_ready(carry[-1])
+        carry = carry[:-1] + (jnp.int32(0),)
+        t0 = time.perf_counter()
+        for i in range(n_ticks):
+            carry = step_j(carry, jnp.int32(1 + (i + 1) * G))
+        total_decisions = int(carry[-1])  # blocks on the queued ticks
+        dt = time.perf_counter() - t0
 
     dps = total_decisions / dt
     backend = jax.devices()[0].platform
@@ -243,7 +209,10 @@ def main():
     # Orchestrator: attempt the ambient (TPU) backend in a subprocess under
     # a watchdog — a broken tunnel can hang backend init for ~40 minutes,
     # which must not silently eat the whole bench budget.
-    tpu_timeout = float(os.environ.get("GPTPU_BENCH_TPU_TIMEOUT_S", 1500))
+    # must leave room inside the DRIVER's ~1500s budget for the CPU
+    # fallback subprocess (~3-4 min) to still emit a parseable line when
+    # the TPU attempt hangs on a dead tunnel
+    tpu_timeout = float(os.environ.get("GPTPU_BENCH_TPU_TIMEOUT_S", 1000))
     diag = None
     try:
         env = dict(os.environ)

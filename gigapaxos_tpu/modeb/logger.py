@@ -33,6 +33,7 @@ from .kernel import unpack_node_tick
 
 OP_FRAME = 6
 OP_CKPT = 7
+OP_EXPAND = 8
 
 
 def replay_node_journals(node, log_dir, start_seq, stage, new_buffers,
@@ -63,6 +64,8 @@ def replay_node_journals(node, log_dir, start_seq, stage, new_buffers,
                 _, name, members, epoch = rec
                 if name not in node.rows:
                     node.create_group(name, members, epoch)
+            elif op == OP_EXPAND:
+                node.expand_universe(rec[1], _log=False)
             elif op == OP_REMOVE:
                 node.remove_group(rec[1])
             elif op == OP_FRAME:
@@ -113,6 +116,13 @@ def replay_node_journals(node, log_dir, start_seq, stage, new_buffers,
 
 
 class ModeBLogger(PaxosLogger):
+    def log_expand(self, new_ids) -> None:
+        """Journal a replica-universe expansion (node addition): replay
+        must re-grow the state arrays before any later record that assumes
+        the larger R."""
+        self.journal.append(pickle.dumps((OP_EXPAND, list(new_ids))))
+        self.journal.sync()
+
     def log_frame(self, payload: bytes) -> None:
         """Journal an applied replica frame (before mirror mutation; rides
         the next tick's group commit for fsync)."""
@@ -150,6 +160,7 @@ class ModeBLogger(PaxosLogger):
     def _meta(self, m) -> dict:
         return {
             "tick_num": m.tick_num,
+            "members": list(m.members),
             "next_seq": m._next_seq,
             "rows": dict(m.rows.items()),
             "free_rows": list(m.rows._free),
@@ -184,12 +195,18 @@ def recover_modeb(cfg, member_ids, node_id, app, log_dir: str,
     from .manager import ModeBNode, ModeBRecord
 
     logger = ModeBLogger(log_dir, native=native)
-    node = ModeBNode(cfg, member_ids, node_id, app)  # no messenger, no wal
     snap_seq = logger._latest_snapshot_seq()
-    start_seq = 0
+    meta = npz_blob = None
     if snap_seq is not None:
         with open(logger._snapshot_path(snap_seq), "rb") as f:
             meta, npz_blob = pickle.loads(f.read())
+    # the universe may have been expanded at runtime (node additions): the
+    # snapshot's member list supersedes the boot topology's, and journaled
+    # OP_EXPAND records extend it further during replay
+    members = list(meta.get("members", member_ids)) if meta else member_ids
+    node = ModeBNode(cfg, members, node_id, app)  # no messenger, no wal
+    start_seq = 0
+    if snap_seq is not None:
         arrs = np.load(io.BytesIO(npz_blob))
         node.state = PaxosState(
             **{f: jnp.asarray(arrs[f]) for f in PaxosState._fields}

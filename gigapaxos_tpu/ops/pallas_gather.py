@@ -30,10 +30,18 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _kernel(arr_ref, idx_ref, out_ref, *, wp: int, j_out: int):
-    # arr [1, Wp, Gb]; idx [J, Gb]; out [1, J, Gb]
+def _lane_block(g: int) -> int:
+    """Largest power-of-two-times-128 divisor of g, capped at 4096 lanes
+    (callers only guarantee g % 128 == 0 — e.g. max_groups = 4224)."""
+    return math.gcd(g, 4096)
+
+
+def _gather_kernel(arr_ref, idx_ref, out_ref, *, wp: int, j_out: int,
+                   perlead: bool):
+    # arr [1, Wp, Gb]; idx [J, Gb] (shared) or [1, J, Gb] (per-lead);
+    # out [1, J, Gb]
     for j in range(j_out):
-        sel = idx_ref[j, :]
+        sel = idx_ref[0, j, :] if perlead else idx_ref[j, :]
         acc = jnp.zeros_like(out_ref[0, j, :])
         for i in range(wp):
             acc = jnp.where(sel == i, arr_ref[0, i, :], acc)
@@ -42,23 +50,24 @@ def _kernel(arr_ref, idx_ref, out_ref, *, wp: int, j_out: int):
 
 @functools.lru_cache(maxsize=None)
 def _build(lead: int, wp: int, j_out: int, g: int, dtype_name: str,
-           interpret: bool):
+           interpret: bool, perlead: bool = False):
     from jax.experimental import pallas as pl
 
     dtype = jnp.dtype(dtype_name)
-    # largest power-of-two-times-128 divisor of g, capped at 4096 lanes
-    # (callers only guarantee g % 128 == 0 — e.g. max_groups = 4224)
-    gb = math.gcd(g, 4096)
-
-    kern = functools.partial(_kernel, wp=wp, j_out=j_out)
-    grid = (lead, g // gb)
+    gb = _lane_block(g)
+    kern = functools.partial(_gather_kernel, wp=wp, j_out=j_out,
+                             perlead=perlead)
+    idx_spec = (
+        pl.BlockSpec((1, j_out, gb), lambda l, b: (l, 0, b)) if perlead
+        else pl.BlockSpec((j_out, gb), lambda l, b: (0, b))
+    )
     return pl.pallas_call(
         kern,
         out_shape=jax.ShapeDtypeStruct((lead, j_out, g), dtype),
-        grid=grid,
+        grid=(lead, g // gb),
         in_specs=[
             pl.BlockSpec((1, wp, gb), lambda l, b: (l, 0, b)),
-            pl.BlockSpec((j_out, gb), lambda l, b: (0, b)),
+            idx_spec,
         ],
         out_specs=pl.BlockSpec((1, j_out, gb), lambda l, b: (l, 0, b)),
         interpret=interpret,
@@ -83,47 +92,13 @@ def gather_planes_pallas(arr, idx, interpret: bool = False):
     if idx.ndim > 2:
         # per-lead indices: flatten into the lead axis pairing
         ix = idx.reshape(lead, j_out, g).astype(jnp.int32)
-        out = _build_perlead(lead, wp, j_out, g, str(a.dtype), interpret)(
-            a, ix
-        )
+        out = _build(lead, wp, j_out, g, str(a.dtype), interpret,
+                     perlead=True)(a, ix)
     else:
         ix = idx.astype(jnp.int32)
         out = _build(lead, wp, j_out, g, str(a.dtype), interpret)(a, ix)
     out = out.reshape(*lead_shape, j_out, g)
     return out.astype(jnp.bool_) if squeeze_bool else out
-
-
-def _kernel_perlead(arr_ref, idx_ref, out_ref, *, wp: int, j_out: int):
-    for j in range(j_out):
-        sel = idx_ref[0, j, :]
-        acc = jnp.zeros_like(out_ref[0, j, :])
-        for i in range(wp):
-            acc = jnp.where(sel == i, arr_ref[0, i, :], acc)
-        out_ref[0, j, :] = acc
-
-
-@functools.lru_cache(maxsize=None)
-def _build_perlead(lead: int, wp: int, j_out: int, g: int, dtype_name: str,
-                   interpret: bool):
-    from jax.experimental import pallas as pl
-
-    dtype = jnp.dtype(dtype_name)
-    # largest power-of-two-times-128 divisor of g, capped at 4096 lanes
-    # (callers only guarantee g % 128 == 0 — e.g. max_groups = 4224)
-    gb = math.gcd(g, 4096)
-
-    kern = functools.partial(_kernel_perlead, wp=wp, j_out=j_out)
-    return pl.pallas_call(
-        kern,
-        out_shape=jax.ShapeDtypeStruct((lead, j_out, g), dtype),
-        grid=(lead, g // gb),
-        in_specs=[
-            pl.BlockSpec((1, wp, gb), lambda l, b: (l, 0, b)),
-            pl.BlockSpec((1, j_out, gb), lambda l, b: (l, 0, b)),
-        ],
-        out_specs=pl.BlockSpec((1, j_out, gb), lambda l, b: (l, 0, b)),
-        interpret=interpret,
-    )
 
 
 def _kernel_match(vals_ref, keys_ref, idx_ref, out_ref, *, e_planes: int,
@@ -143,9 +118,7 @@ def _build_match(e_planes: int, j_out: int, g: int, dtype_name: str,
     from jax.experimental import pallas as pl
 
     dtype = jnp.dtype(dtype_name)
-    # largest power-of-two-times-128 divisor of g, capped at 4096 lanes
-    # (callers only guarantee g % 128 == 0 — e.g. max_groups = 4224)
-    gb = math.gcd(g, 4096)
+    gb = _lane_block(g)
     kern = functools.partial(_kernel_match, e_planes=e_planes, j_out=j_out)
     return pl.pallas_call(
         kern,

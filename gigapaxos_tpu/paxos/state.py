@@ -141,6 +141,28 @@ def init_state(n_replicas: int, n_groups: int, window: int) -> PaxosState:
     )
 
 
+def expand_replica_slots(state: PaxosState, n_new: int) -> PaxosState:
+    """Grow the replica axis by ``n_new`` virgin slots (runtime node
+    addition — the ReconfigureActiveNodeConfig analog for the dense layout,
+    Reconfigurator.java:1044).  Existing slots keep their indices (new nodes
+    append), new rows hold the same initial values as :func:`init_state`,
+    and no group membership changes — groups adopt the new slots through
+    ordinary epoch reconfiguration afterwards."""
+    if n_new <= 0:
+        return state
+    R = state.exec_slot.shape[0]
+    fresh = init_state(n_new, state.exec_slot.shape[1],
+                       state.acc_req.shape[1])
+    merged = {}
+    for f in PaxosState._fields:
+        a, b = getattr(state, f), getattr(fresh, f)
+        if a.ndim >= 2 and a.shape[0] == R:
+            merged[f] = jnp.concatenate([a, b], axis=0)
+        else:  # per-group config state ([G]): unchanged
+            merged[f] = a
+    return PaxosState(**merged)
+
+
 def create_groups(state: PaxosState, rows: np.ndarray, members: np.ndarray,
                   epochs: np.ndarray | None = None) -> PaxosState:
     """Open group rows (batched `createPaxosInstance`,

@@ -135,6 +135,7 @@ def run_bench() -> dict:
         run_j = jax.jit(run_n, donate_argnums=(0,))
         carry = run_j(carry0, jnp.int32(1))  # compile + warm
         jax.block_until_ready(carry[-1])
+        carry = carry[:-1] + (jnp.int32(0),)  # reset acc: count timed only
         t0 = time.perf_counter()
         carry = run_j(carry, jnp.int32(1 + n_ticks * G))
         total_decisions = int(carry[-1])  # blocks until the scan completes

@@ -20,7 +20,7 @@ from __future__ import annotations
 import dataclasses
 import os
 from dataclasses import dataclass, field
-from typing import Dict, Tuple
+from typing import Dict, List, Tuple
 
 
 @dataclass
@@ -102,9 +102,21 @@ class NodeConfig:
 
     actives: Dict[str, Tuple[str, int]] = field(default_factory=dict)
     reconfigurators: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    # Explicit replica-slot order for Mode B universes (boot topology +
+    # runtime-added nodes in committed order; slots of removed nodes are
+    # retained, never recycled).  Empty = sorted actives — correct ONLY for
+    # clusters whose node set never changed.  After ANY add/remove, a node
+    # restoring without its own WAL must boot with the committed order
+    # (properties key ``universe=A0,A1,...``, returned by the add_active
+    # response) or its slot indices silently diverge from the incumbents'.
+    # Nodes with an intact WAL recover their member list from it.
+    universe: List[str] = field(default_factory=list)
 
     def active_ids(self):
         return sorted(self.actives)
+
+    def universe_order(self):
+        return list(self.universe) if self.universe else sorted(self.actives)
 
     def reconfigurator_ids(self):
         return sorted(self.reconfigurators)
@@ -153,7 +165,9 @@ def load_properties(path: str) -> GigapaxosTpuConfig:
                 continue
             key, val = line.split("=", 1)
             key, val = key.strip(), val.strip()
-            if key.startswith("active."):
+            if key == "universe":
+                cfg.nodes.universe = [x.strip() for x in val.split(",") if x.strip()]
+            elif key.startswith("active."):
                 host, port = val.rsplit(":", 1)
                 cfg.nodes.actives[key[len("active.") :]] = (host, int(port))
             elif key.startswith("reconfigurator."):

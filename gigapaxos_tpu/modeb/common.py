@@ -46,6 +46,10 @@ class ModeBCommon:
         self._fd = None
         self.on_work: Optional[Callable[[], None]] = None
         self.whois_birth: Optional[Callable[[str], bool]] = None
+        #: called with the list of freshly appended member ids after a
+        #: runtime universe expansion (coordinators refresh their
+        #: id<->slot caches here)
+        self.on_expand: list = []
 
     # ------------------------------------------------------------- rid space
     def next_rid(self) -> int:

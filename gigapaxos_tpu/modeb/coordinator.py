@@ -53,6 +53,9 @@ class ModeBReplicaCoordinator(AbstractReplicaCoordinator):
         node.whois_birth = lambda _name: False
         self.node_ids = list(node.members)
         self._slot: Dict[str, int] = {n: i for i, n in enumerate(self.node_ids)}
+        # runtime node additions append replica slots; keep the id<->slot
+        # view in lockstep (ReconfigureActiveNodeConfig analog)
+        node.on_expand.append(self._on_expand)
         self._epoch: Dict[str, int] = {}
         # recovery: the node's rows came back from its own journal; rebuild
         # the live-epoch map from the `name#e` namespace (highest epoch wins
@@ -67,6 +70,10 @@ class ModeBReplicaCoordinator(AbstractReplicaCoordinator):
                 continue
             if epoch > self._epoch.get(name, -1):
                 self._epoch[name] = epoch
+
+    def _on_expand(self, _fresh) -> None:
+        self.node_ids = list(self.node.members)
+        self._slot = {n: i for i, n in enumerate(self.node_ids)}
 
     # ----------------------------------------------------------------- naming
     @staticmethod
@@ -191,6 +198,11 @@ class ModeBRepliconfigurableDB:
         #: topology, start their processes later, then add_reconfigurator)
         self.rc_ids = sorted(rc_ids)
         self._slot = {n: i for i, n in enumerate(node.members)}
+        node.on_expand.append(
+            lambda _fresh: self._slot.update(
+                {n: i for i, n in enumerate(node.members)}
+            )
+        )
         self.ring = ConsistentHashRing(self.rc_ids)
         self.k = min(k, len(self.rc_ids))
         db = node.app

@@ -270,6 +270,8 @@ class ModeBNode(ModeBCommon):
             self.stats["universe_expansions"] += 1
             if _log and self.wal is not None:
                 self.wal.log_expand(fresh)
+            for hook in self.on_expand:
+                hook(fresh)
             return True
 
     def is_stopped(self, name: str) -> bool:

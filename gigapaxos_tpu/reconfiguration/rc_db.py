@@ -146,8 +146,25 @@ class ReconfiguratorDB(Replicable):
                 )
             node = cmd["node"]
             pool = set(rec.actives)
+            if not rec.universe:
+                # seed the ordered slot universe from the boot topology
+                # (sorted — every node derives the same boot order)
+                rec.universe = sorted(cmd.get("seed_pool", rec.actives))
             if op == "add_active":
+                if (node not in rec.universe
+                        and len(rec.universe) >= (1 << 6)):
+                    # the rid encoding carries the replica slot in 6 bits
+                    # (modeb/common.py RID_SHIFT): reject HERE, inside the
+                    # totally ordered apply, or the commit would succeed
+                    # while every data plane refuses to expand
+                    return {"ok": False, "error": "universe_full",
+                            "pool": rec.actives}
                 pool.add(node)
+                if node not in rec.universe:
+                    # replica-slot order is append-only and totally ordered
+                    # by this commit stream: Mode B universes derive their
+                    # slot indices from it (expand_universe appends)
+                    rec.universe.append(node)
             else:
                 pool.discard(node)
                 # the shrink invariant must hold HERE, inside the totally
@@ -157,9 +174,12 @@ class ReconfiguratorDB(Replicable):
                 if len(pool) < min_pool:
                     return {"ok": False, "error": "pool_too_small",
                             "pool": rec.actives}
+                # the node leaves the placement POOL but its slot is never
+                # recycled (a re-add reuses the same slot index)
             rec.actives = sorted(pool)
             rec.epoch += 1  # NC epoch counts config versions
-            return {"ok": True, "pool": rec.actives, "epoch": rec.epoch}
+            return {"ok": True, "pool": rec.actives, "epoch": rec.epoch,
+                    "universe": list(rec.universe)}
         if op == "create":
             if rec is not None:
                 return {"ok": False, "error": "exists", "epoch": rec.epoch}

@@ -762,6 +762,10 @@ class Reconfigurator:
                 "type": pkt.NODE_CONFIG_RESPONSE, "rid": rid,
                 "ok": bool(result.get("ok")), "node": node,
                 "pool": result.get("pool"),
+                # the committed replica-slot order: the operator puts this
+                # in the new node's properties (``universe=...``) so its
+                # boot slot indices match the incumbents'
+                "universe": result.get("universe"),
             })
 
         self.rdb.commit(NC_RECORD, cmd, committed, proposer=self.node_id)
@@ -778,6 +782,20 @@ class Reconfigurator:
                 # overwrite unconditionally: a node removed and re-added at
                 # a new address must not keep its stale routing entry
                 self.m.nodemap.add(node, addr[0], int(addr[1]))
+            # push the committed slot order to every active so Mode B data
+            # planes grow their replica universe in lockstep (idempotent:
+            # each broadcast carries the complete order; a server that
+            # missed one catches up from the next)
+            universe = (record or {}).get("universe") or pool
+            addrs = {node: list(addr)} if addr else {}
+            for a in pool:
+                try:
+                    self.m.send(a, {
+                        "type": "nc_universe_apply",
+                        "universe": list(universe), "addrs": addrs,
+                    })
+                except Exception:  # a down active learns from its WAL/boot
+                    pass
             return
         # removal: drain the node with a retrying task, not a one-shot pass —
         # names mid-reconfiguration (or whose primary is down) at commit time

@@ -43,6 +43,11 @@ class ReconfigurationRecord:
     delete_time: Optional[float] = None
     # RC-epoch bookkeeping for the special NC (node-config) record
     rc_epochs: Dict[str, int] = field(default_factory=dict)
+    # NC record only: the ordered replica-slot universe (boot topology +
+    # runtime-added nodes in commit order).  Mode B slot indices derive
+    # from this order, so it must be identical on every node — it is
+    # state of the paxos-replicated NC record, not local configuration.
+    universe: List[str] = field(default_factory=list)
 
     # ------------------------------------------------------------ transitions
     def can_reconfigure(self) -> bool:
@@ -93,6 +98,7 @@ class ReconfigurationRecord:
             "new_actives": list(self.new_actives),
             "delete_time": self.delete_time,
             "rc_epochs": dict(self.rc_epochs),
+            "universe": list(self.universe),
         }
 
     @classmethod
@@ -105,4 +111,5 @@ class ReconfigurationRecord:
             new_actives=list(d.get("new_actives", [])),
             delete_time=d.get("delete_time"),
             rc_epochs=dict(d.get("rc_epochs", {})),
+            universe=list(d.get("universe", [])),
         )

@@ -32,6 +32,7 @@ or embed (tests boot several in one process on loopback, the
 from __future__ import annotations
 
 import argparse
+import logging
 import os
 import signal
 import threading
@@ -49,6 +50,8 @@ from .reconfiguration.active_replica import ActiveReplica
 from .reconfiguration.demand import AbstractDemandProfile, DemandProfile
 from .reconfiguration.rc_db import ReconfiguratorDB
 from .reconfiguration.reconfigurator import Reconfigurator
+
+log = logging.getLogger(__name__)
 
 
 class ModeBServer:
@@ -74,6 +77,12 @@ class ModeBServer:
         self.node_id = node_id
         self.cfg = cfg
         self.nodemap = NodeMap(cfg.nodes)
+        # Two distinct node lists: the replica-slot UNIVERSE (append-only,
+        # committed NC order — data-plane member axis) and the live
+        # placement POOL (current actives — what reconfigurators place new
+        # names on).  The universe may retain removed nodes whose slots are
+        # never recycled; the pool must not.
+        universe_ids = cfg.nodes.universe_order()
         active_ids = cfg.nodes.active_ids()
         rc_ids = cfg.nodes.reconfigurator_ids()
         self.is_active = node_id in cfg.nodes.actives
@@ -110,19 +119,19 @@ class ModeBServer:
                            if log_dir else None)
                 if wal_dir and os.path.isdir(wal_dir) and os.listdir(wal_dir):
                     node = recover_chain_modeb(
-                        cfg, active_ids, node_id, self.app, wal_dir,
+                        cfg, universe_ids, node_id, self.app, wal_dir,
                         native=cfg.native_journal,
                     )
                     recovered = True
                 else:
                     wal = (ChainBLogger(wal_dir, native=cfg.native_journal)
                            if wal_dir else None)
-                    node = ChainModeBNode(cfg, active_ids, node_id, self.app,
-                                          wal=wal)
+                    node = ChainModeBNode(cfg, universe_ids, node_id,
+                                          self.app, wal=wal)
                     recovered = False
             elif coordinator == "paxos":
                 node, recovered = self._make_node(
-                    active_ids, self.app,
+                    universe_ids, self.app,
                     os.path.join(log_dir, f"{node_id}-ar") if log_dir else None,
                 )
             else:
@@ -136,11 +145,12 @@ class ModeBServer:
                 rc_group_size=rc_group_size,
             )
             node.attach_messenger(m)
+            m.register("nc_universe_apply", self._on_nc_universe)
             if recovered:
                 node.request_sync()
             if start_fd:
                 fd = FailureDetection(
-                    m, monitored=active_ids,
+                    m, monitored=universe_ids,
                     ping_interval_s=cfg.fd.ping_interval_s,
                     timeout_s=cfg.fd.timeout_s,
                 )
@@ -200,6 +210,35 @@ class ModeBServer:
 
         if self.reporter is not None:
             self.reporter.start()
+
+    def _on_nc_universe(self, sender: str, p: dict) -> None:
+        """A reconfigurator committed a node addition: adopt the new
+        node's address and grow this plane's replica universe to match the
+        committed slot order (idempotent; lost broadcasts are repaired by
+        the next one, which carries the complete order)."""
+        for nid, addr in (p.get("addrs") or {}).items():
+            self.nodemap.add(nid, addr[0], int(addr[1]))
+        uni = list(p.get("universe") or [])
+        node = self.node
+        if node is None or not hasattr(node, "expand_universe"):
+            return
+        with node.lock:
+            known = list(node.members)
+        if uni[: len(known)] != known:
+            # a conflicting order would desync slot indices across nodes —
+            # never apply it (this node's own WAL/boot order is authoritative
+            # for the prefix it already has)
+            log.warning("%s: nc universe %s conflicts with members %s",
+                        self.node_id, uni, known)
+            return
+        fresh = uni[len(known):]
+        if fresh:
+            try:
+                node.expand_universe(fresh)
+            except ValueError:
+                # cap enforcement lives in the NC apply; this guard keeps a
+                # malformed broadcast from killing the handler thread
+                log.exception("%s: universe expansion rejected", self.node_id)
 
     @staticmethod
     def _start_driver(node: ModeBNode) -> TickDriver:

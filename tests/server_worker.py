@@ -35,6 +35,8 @@ def main() -> None:
         cfg.nodes.actives[nid] = (host, int(port))
     for nid, (host, port) in spec["rcs"].items():
         cfg.nodes.reconfigurators[nid] = (host, int(port))
+    if spec.get("universe"):
+        cfg.nodes.universe = list(spec["universe"])
 
     server = ModeBServer(
         node_id, cfg,

@@ -1,0 +1,118 @@
+"""NC-driven Mode B universe expansion: client.add_active -> committed NC
+record -> broadcast -> every active's data plane grows in lockstep -> the
+new server boots with the committed slot order -> names migrate onto it.
+
+The newcomer's id ("AR1") deliberately sorts BETWEEN the incumbents
+("AR0", "AR2", "AR4"): sorted-topology boot order would give it the wrong
+slot index, so this exercises the committed-universe-order mechanism
+(NC record ``universe`` field -> add_active response -> ``nodes.universe``
+boot key).
+"""
+
+import time
+
+import pytest
+
+from gigapaxos_tpu.client import ReconfigurableAppClient
+from gigapaxos_tpu.config import GigapaxosTpuConfig
+from gigapaxos_tpu.server import ModeBServer
+
+ACTIVES = ["AR0", "AR2", "AR4"]
+RCS = ["RC0", "RC1", "RC2"]
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def make_cfg():
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 32
+    cfg.fd.ping_interval_s = 0.05
+    cfg.fd.timeout_s = 1.5
+    for nid in ACTIVES:
+        cfg.nodes.actives[nid] = ("127.0.0.1", _free_port())
+    for nid in RCS:
+        cfg.nodes.reconfigurators[nid] = ("127.0.0.1", _free_port())
+    return cfg
+
+
+def test_nc_add_active_expands_universes_and_migrates():
+    cfg = make_cfg()
+    srv = {}
+    client = None
+    newcomer = None
+    try:
+        for nid in ACTIVES + RCS:
+            srv[nid] = ModeBServer(nid, cfg, start_fd=True)
+        for s in srv.values():
+            assert s.wait_ready(300)
+        client = ReconfigurableAppClient(cfg.nodes)
+
+        assert client.create("svc", timeout=90)["ok"]
+        assert client.request("svc", b"PUT city paris", timeout=60) == b"OK"
+
+        # ---- add AR1 (sorts between AR0 and AR2) ----
+        new_port = _free_port()
+        resp = client.add_active("AR1", "127.0.0.1", new_port, timeout=60)
+        assert resp["ok"], resp
+        universe = resp.get("universe")
+        assert universe == ACTIVES + ["AR1"], universe
+
+        # every incumbent's data plane grows to R=4 with AR1 LAST
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if all(srv[a].node.R == 4 and srv[a].node.members[-1] == "AR1"
+                   for a in ACTIVES):
+                break
+            time.sleep(0.2)
+        for a in ACTIVES:
+            assert srv[a].node.members == universe, (a, srv[a].node.members)
+
+        # ---- boot the newcomer with the COMMITTED slot order ----
+        import copy
+
+        cfg2 = copy.deepcopy(cfg)
+        cfg2.nodes.actives["AR1"] = ("127.0.0.1", new_port)
+        cfg2.nodes.universe = list(universe)
+        newcomer = ModeBServer("AR1", cfg2, start_fd=True)
+        assert newcomer.wait_ready(300)
+        assert newcomer.node.members == universe
+
+        # ---- migrate the name onto the newcomer and use it ----
+        new_set = ["AR1", "AR2", "AR4"]
+        r = client.reconfigure("svc", new_set, timeout=90)
+        assert r["ok"], r
+        deadline = time.monotonic() + 120
+        got = set()
+        while time.monotonic() < deadline:
+            got = set(client.request_actives("svc", force=True))
+            if got == set(new_set):
+                break
+            time.sleep(0.3)
+        assert got == set(new_set)
+        assert client.request("svc", b"GET city", timeout=60) == b"paris"
+        assert client.request("svc", b"PUT n 1", timeout=60) == b"OK"
+        # the newcomer's own app copy converges (it is a real member)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            db = getattr(newcomer.app, "db", {})
+            ok = any(t.get("city") == "paris" for t in db.values())
+            if ok:
+                break
+            time.sleep(0.2)
+        assert any(t.get("city") == "paris"
+                   for t in getattr(newcomer.app, "db", {}).values())
+    finally:
+        if client is not None:
+            client.close()
+        if newcomer is not None:
+            newcomer.close()
+        for s in srv.values():
+            s.close()

@@ -339,6 +339,13 @@ class ChainModeBNode(ModeBCommon):
             self._purge_staged_row(row)
             return True
 
+    def _expand_state(self, n_new: int) -> None:
+        self.state = st.expand_replica_slots(self.state, n_new)
+
+    def _reset_intake_buffers(self) -> None:
+        self._in_req = np.zeros((self.P, self.G), np.int32)
+        self._in_stp = np.zeros((self.P, self.G), bool)
+
     def is_stopped(self, name: str) -> bool:
         row = self.rows.row(name)
         return row is not None and row in self._stopped_rows

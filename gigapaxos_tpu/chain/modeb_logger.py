@@ -27,6 +27,7 @@ class ChainBLogger(ModeBLogger):
     def _meta(self, m) -> dict:
         return {
             "tick_num": m.tick_num,
+            "members": list(m.members),
             "next_seq": m._next_seq,
             "rows": dict(m.rows.items()),
             "free_rows": list(m.rows._free),
@@ -61,12 +62,17 @@ def recover_chain_modeb(cfg, member_ids, node_id, app, log_dir: str,
     from .tick import ChainInbox
 
     logger = ChainBLogger(log_dir, native=native)
-    node = ChainModeBNode(cfg, member_ids, node_id, app)
     snap_seq = logger._latest_snapshot_seq()
-    start_seq = 0
+    meta = npz_blob = None
     if snap_seq is not None:
         with open(logger._snapshot_path(snap_seq), "rb") as f:
             meta, npz_blob = pickle.loads(f.read())
+    # a runtime-expanded universe supersedes the boot topology (see
+    # modeb/logger.recover_modeb); journaled OP_EXPANDs extend it further
+    members = list(meta.get("members", member_ids)) if meta else member_ids
+    node = ChainModeBNode(cfg, members, node_id, app)
+    start_seq = 0
+    if snap_seq is not None:
         arrs = np.load(io.BytesIO(npz_blob))
         node.state = ChainState(
             **{f: jnp.asarray(arrs[f]) for f in ChainState._fields}

@@ -74,6 +74,19 @@ def init_state(n_replicas: int, n_groups: int, window: int) -> ChainState:
     )
 
 
+def expand_replica_slots(state: ChainState, n_new: int) -> ChainState:
+    """Grow the replica axis by ``n_new`` virgin slots (runtime node
+    addition — see paxos/state.expand_replica_slots)."""
+    from ..paxos.state import concat_replica_slots
+
+    if n_new <= 0:
+        return state
+    return concat_replica_slots(
+        state,
+        init_state(n_new, state.applied.shape[1], state.c_req.shape[1]),
+    )
+
+
 def create_groups(state: ChainState, rows: np.ndarray, members: np.ndarray,
                   epochs: np.ndarray | None = None) -> ChainState:
     """Open chain rows (ChainManager.createReplicatedChain analog)."""

@@ -93,6 +93,61 @@ class ModeBCommon:
             self._routed.popitem(last=False)
         return True
 
+    # ------------------------------------------------------------ expansion
+    def expand_universe(self, new_ids, _log: bool = True) -> bool:
+        """Grow the replica universe at runtime: append ``new_ids`` as
+        fresh slots (ReconfigureActiveNodeConfig analog,
+        Reconfigurator.java:1044).  Every member node must apply the same
+        expansion in the same order (drive it from a committed node-config
+        record) so slot indices agree; the new node itself boots with the
+        full expanded topology.  Existing groups are untouched — they adopt
+        the new slots through ordinary epoch reconfiguration — and the new
+        slots start dead until the failure detector hears from them.
+
+        Flavor hooks: ``_pre_expand`` (e.g. drain a tick pipeline whose
+        outbox shapes change with R), ``_expand_state(n_new)`` (grow the
+        protocol state arrays), ``_reset_intake_buffers`` (re-size the
+        per-tick staging)."""
+        import numpy as np
+
+        with self.lock:
+            fresh = [nid for nid in new_ids if nid not in self.members]
+            if not fresh:
+                return False
+            if self.R + len(fresh) > (1 << 6):
+                raise ValueError("replica-slot space exceeds rid encoding")
+            self._pre_expand()
+            self.members.extend(fresh)
+            self.R = len(self.members)
+            self.alive = np.concatenate(
+                [self.alive, np.zeros(len(fresh), bool)]
+            )
+            self._expand_state(len(fresh))
+            self._reset_intake_buffers()
+            if self._fd is not None:
+                for nid in fresh:
+                    self._fd.monitor(nid)
+            # the jit re-specializes on the new shapes automatically; the
+            # frame codec carries sender_r explicitly, and peers that have
+            # not expanded yet drop frames with sender_r >= their R until
+            # their own expansion commits (eventual agreement rides the
+            # same committed node-config stream)
+            self.stats["universe_expansions"] += 1
+            if _log and self.wal is not None:
+                self.wal.log_expand(fresh)
+            for hook in self.on_expand:
+                hook(fresh)
+            return True
+
+    def _pre_expand(self) -> None:  # overridable
+        pass
+
+    def _expand_state(self, n_new: int) -> None:
+        raise NotImplementedError
+
+    def _reset_intake_buffers(self) -> None:
+        raise NotImplementedError
+
     # ------------------------------------------------------------- liveness
     def set_alive(self, r: int, up: bool) -> None:
         self.alive[r] = up

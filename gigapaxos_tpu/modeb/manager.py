@@ -232,47 +232,15 @@ class ModeBNode(ModeBCommon):
                 self.wal.log_remove(name)
             return True
 
-    def expand_universe(self, new_ids: List[str], _log: bool = True) -> bool:
-        """Grow the replica universe at runtime: append ``new_ids`` as fresh
-        replica slots (ReconfigureActiveNodeConfig analog,
-        Reconfigurator.java:1044 — the round-2 gap "process universes are
-        fixed at boot").
+    def _pre_expand(self) -> None:
+        self.drain_pipeline()  # pending outbox shapes change with R
 
-        Every member node must apply the same expansion in the same order
-        (drive it from a paxos-committed node-config record) so slot
-        indices agree; the new node itself boots with the full expanded
-        topology.  Existing groups are untouched — they adopt the new
-        slots through ordinary epoch reconfiguration afterwards.  The new
-        slots start dead until the failure detector hears from them."""
-        with self.lock:
-            fresh = [nid for nid in new_ids if nid not in self.members]
-            if not fresh:
-                return False
-            if self.R + len(fresh) > (1 << 6):
-                raise ValueError("replica-slot space exceeds rid encoding")
-            self.drain_pipeline()  # outbox shapes change with R
-            self.members.extend(fresh)
-            n_new = len(fresh)
-            self.R = len(self.members)
-            self.alive = np.concatenate([self.alive,
-                                         np.zeros(n_new, bool)])
-            self.state = st.expand_replica_slots(self.state, n_new)
-            self._in_req = np.zeros((self.R, self.P, self.G), np.int32)
-            self._in_stp = np.zeros((self.R, self.P, self.G), bool)
-            if self._fd is not None:
-                for nid in fresh:
-                    self._fd.monitor(nid)
-            # the jit re-specializes on the new shapes automatically; the
-            # frame codec carries sender_r explicitly, and peers that have
-            # not expanded yet drop frames with sender_r >= their R until
-            # their own expansion commits (eventual agreement rides the
-            # same committed node-config stream)
-            self.stats["universe_expansions"] += 1
-            if _log and self.wal is not None:
-                self.wal.log_expand(fresh)
-            for hook in self.on_expand:
-                hook(fresh)
-            return True
+    def _expand_state(self, n_new: int) -> None:
+        self.state = st.expand_replica_slots(self.state, n_new)
+
+    def _reset_intake_buffers(self) -> None:
+        self._in_req = np.zeros((self.R, self.P, self.G), np.int32)
+        self._in_stp = np.zeros((self.R, self.P, self.G), bool)
 
     def is_stopped(self, name: str) -> bool:
         row = self.rows.row(name)

@@ -141,6 +141,24 @@ def init_state(n_replicas: int, n_groups: int, window: int) -> PaxosState:
     )
 
 
+def concat_replica_slots(state, fresh):
+    """Append ``fresh``'s virgin replica rows to ``state`` (both the same
+    NamedTuple type): every field whose leading dim is the replica axis is
+    concatenated; per-group config state ([G]) is unchanged.  The leading-
+    dim test is by ndim (>= 2) — protocol states must not add 2-D [G, *]
+    fields or this heuristic needs revisiting.  Shared by the paxos and
+    chain expanders (runtime node addition, Reconfigurator.java:1044)."""
+    R = state[0].shape[0]
+    merged = {}
+    for f in state._fields:
+        a, b = getattr(state, f), getattr(fresh, f)
+        if a.ndim >= 2 and a.shape[0] == R:
+            merged[f] = jnp.concatenate([a, b], axis=0)
+        else:
+            merged[f] = a
+    return type(state)(**merged)
+
+
 def expand_replica_slots(state: PaxosState, n_new: int) -> PaxosState:
     """Grow the replica axis by ``n_new`` virgin slots (runtime node
     addition — the ReconfigureActiveNodeConfig analog for the dense layout,
@@ -150,17 +168,10 @@ def expand_replica_slots(state: PaxosState, n_new: int) -> PaxosState:
     ordinary epoch reconfiguration afterwards."""
     if n_new <= 0:
         return state
-    R = state.exec_slot.shape[0]
-    fresh = init_state(n_new, state.exec_slot.shape[1],
-                       state.acc_req.shape[1])
-    merged = {}
-    for f in PaxosState._fields:
-        a, b = getattr(state, f), getattr(fresh, f)
-        if a.ndim >= 2 and a.shape[0] == R:
-            merged[f] = jnp.concatenate([a, b], axis=0)
-        else:  # per-group config state ([G]): unchanged
-            merged[f] = a
-    return PaxosState(**merged)
+    return concat_replica_slots(
+        state,
+        init_state(n_new, state.exec_slot.shape[1], state.acc_req.shape[1]),
+    )
 
 
 def create_groups(state: PaxosState, rows: np.ndarray, members: np.ndarray,

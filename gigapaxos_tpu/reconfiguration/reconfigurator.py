@@ -784,10 +784,17 @@ class Reconfigurator:
                 self.m.nodemap.add(node, addr[0], int(addr[1]))
             # push the committed slot order to every active so Mode B data
             # planes grow their replica universe in lockstep (idempotent:
-            # each broadcast carries the complete order; a server that
-            # missed one catches up from the next)
+            # each broadcast carries the complete order AND every address
+            # this RC can resolve, so a server that missed an earlier add
+            # catches up on both the slots and the routing from the next)
             universe = (record or {}).get("universe") or pool
-            addrs = {node: list(addr)} if addr else {}
+            addrs = {}
+            for nid in universe:
+                a_ = self.m.nodemap(nid)
+                if a_ is not None:
+                    addrs[nid] = list(a_)
+            if addr:
+                addrs[node] = list(addr)
             for a in pool:
                 try:
                     self.m.send(a, {

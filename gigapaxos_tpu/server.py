@@ -225,6 +225,10 @@ class ModeBServer:
         with node.lock:
             known = list(node.members)
         if uni[: len(known)] != known:
+            if uni == known[: len(uni)]:
+                # stale broadcast (an earlier add, delivered late over a
+                # different RC's connection): already applied, nothing to do
+                return
             # a conflicting order would desync slot indices across nodes —
             # never apply it (this node's own WAL/boot order is authoritative
             # for the prefix it already has)

@@ -291,3 +291,38 @@ def test_chain_stop_fences(cluster):
     ) is None
     cluster.ticks(5)
     assert got == [None]
+
+
+def test_chain_expand_universe_and_commit_through_new_tail():
+    """Runtime chain-universe expansion: every member appends the new
+    node's slot, the newcomer joins, and a chain spanning old + NEW slots
+    commits with the newcomer as its tail (chain flavor of
+    ModeBNode.expand_universe; tests/test_modeb_expand.py covers paxos)."""
+    cl = Cluster(make_cfg(groups=16))
+    try:
+        cl.create("old")
+        assert cl.commit("C0", "old", b"PUT a 1") == b"OK"
+
+        # expand every live member, then boot the newcomer last
+        m3 = Messenger("C3", ("127.0.0.1", 0), cl.nodemap)
+        cl.nodemap.add("C3", "127.0.0.1", m3.port)
+        for n in cl.nodes.values():
+            assert n.expand_universe(["C3"])
+        cl.apps["C3"] = KVApp()
+        cl.nodes["C3"] = ChainModeBNode(
+            cl.cfg, IDS + ["C3"], "C3", cl.apps["C3"], m3,
+            anti_entropy_every=16,
+        )
+        for nid in IDS:
+            cl.nodes[nid].set_alive(3, True)  # FD stand-in (see modeb tests)
+
+        # chain 1 -> 2 -> 3: the NEWCOMER is the tail (the commit point),
+        # so the write only acks once C3 really applied it
+        for n in cl.nodes.values():
+            n.create_group("mix", [1, 2, 3])
+        assert cl.commit("C1", "mix", b"PUT k v") == b"OK"
+        assert cl.apps["C3"].db.get("mix", {}).get("k") == "v"
+        # the old chain still works after expansion
+        assert cl.commit("C2", "old", b"PUT b 2") == b"OK"
+    finally:
+        cl.close()

@@ -16,6 +16,7 @@ any of these must land in ONE place:
 from __future__ import annotations
 
 import collections
+import threading
 from typing import Callable, Optional
 
 import numpy as np
@@ -35,6 +36,9 @@ class ModeBCommon:
 
     def _init_common(self) -> None:
         self._next_seq = 1
+        #: guards the rid sequence: next_rid runs on client threads (the
+        #: lock-free propose fast path) while bump_seq runs on the tick
+        self._seq_lock = threading.Lock()
         self.payloads: "collections.OrderedDict[int, tuple]" = (
             collections.OrderedDict()
         )
@@ -53,16 +57,17 @@ class ModeBCommon:
 
     # ------------------------------------------------------------- rid space
     def next_rid(self) -> int:
-        if self._next_seq >= RID_MASK:
-            # the sequence would bleed into the origin bits and corrupt rid
-            # routing — fail loudly instead of silently colliding
-            raise RuntimeError(
-                f"{self.node_id}: rid sequence space exhausted "
-                f"({self._next_seq} >= 2^{RID_SHIFT})"
-            )
-        rid = (self.r << RID_SHIFT) | self._next_seq
-        self._next_seq += 1
-        return rid
+        with self._seq_lock:
+            if self._next_seq >= RID_MASK:
+                # the sequence would bleed into the origin bits and corrupt
+                # rid routing — fail loudly instead of silently colliding
+                raise RuntimeError(
+                    f"{self.node_id}: rid sequence space exhausted "
+                    f"({self._next_seq} >= 2^{RID_SHIFT})"
+                )
+            rid = (self.r << RID_SHIFT) | self._next_seq
+            self._next_seq += 1
+            return rid
 
     def bump_seq(self, rids) -> None:
         """Advance the local rid sequence past any observed own-origin rids
@@ -74,8 +79,9 @@ class ModeBCommon:
             return
         mine = a[(a >> RID_SHIFT) == self.r]
         if mine.size:
-            self._next_seq = max(self._next_seq,
-                                 int(mine.max() & RID_MASK) + 1)
+            with self._seq_lock:
+                self._next_seq = max(self._next_seq,
+                                     int(mine.max() & RID_MASK) + 1)
 
     # --------------------------------------------------------- payload store
     def _store_payload(self, rid: int, payload: bytes, stop: bool) -> None:

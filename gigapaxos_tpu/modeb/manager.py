@@ -132,6 +132,8 @@ class ModeBNode(ModeBCommon):
         self._placed: list = []
         #: pipelined mode: (outbox, placed) of the last dispatched tick
         self._pending_out = None
+        #: lock-free propose staging, drained at each tick
+        self._staged: collections.deque = collections.deque()
         self._pending_whois: set = set()
         #: decoded frames awaiting the once-per-tick fused mirror apply:
         #: (sender_r, local_rows, frame_row_selector, Frame)
@@ -276,19 +278,37 @@ class ModeBNode(ModeBCommon):
     def propose(self, name: str, payload: bytes,
                 callback: Optional[Callable[[int, Optional[bytes]], None]] = None,
                 stop: bool = False) -> Optional[int]:
-        with self.lock:
+        """Lock-free fast path: stage the request for the next tick's drain
+        (see paxos/manager.propose — the existence/fenced pre-checks are
+        racy reads; the authoritative outcome rides the callback)."""
+        row = self.rows.row(name)  # racy read: benign
+        if row is None or row in self._stopped_rows:
+            if callback is not None:
+                with self.lock:
+                    self._held_callbacks.append((callback, -1, None))
+            return None
+        rid = self.next_rid()
+        self._staged.append((rid, name, payload, callback, stop))
+        self._wake()
+        return rid
+
+    def _drain_staged(self) -> None:
+        """Admit staged proposals (start of each tick, lock held)."""
+        while True:
+            try:
+                rid, name, payload, callback, stop = self._staged.popleft()
+            except IndexError:
+                return
             row = self.rows.row(name)
             if row is None or row in self._stopped_rows:
+                # the group vanished or stopped between stage and drain
                 if callback is not None:
-                    self._held_callbacks.append((callback, -1, None))
-                return None
-            rid = self.next_rid()
+                    self._held_callbacks.append((callback, rid, None))
+                continue
             rec = ModeBRecord(rid, name, row, payload, stop, callback,
                               self.tick_num)
             self.outstanding[rid] = rec
             self._route(rec)
-        self._wake()
-        return rid
 
     def propose_stop(self, name: str, payload: bytes = b"", callback=None):
         return self.propose(name, payload, callback, stop=True)
@@ -397,6 +417,7 @@ class ModeBNode(ModeBCommon):
         return out
 
     def _build_inbox(self) -> TickInbox:
+        self._drain_staged()
         req, stp = self._in_req, self._in_stp
         for _row, take in self._placed:
             for _rid, p in take:
@@ -788,7 +809,7 @@ class ModeBNode(ModeBCommon):
     # ------------------------------------------------------------ driver shim
     def pending_count(self) -> int:
         with self.lock:
-            n = sum(len(q) for q in self._queues.values())
+            n = sum(len(q) for q in self._queues.values()) + len(self._staged)
             n += sum(1 for rec in self.outstanding.values()
                      if not rec.responded)
             if self._pending_out is not None:

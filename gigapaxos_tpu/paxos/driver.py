@@ -77,6 +77,11 @@ class TickDriver:
                 time.sleep(0.0005)
             elif lock.waiters > 0:
                 time.sleep(0.0005)
+            else:
+                # clients stage proposals without touching the lock now, so
+                # lock contention no longer signals their presence: yield
+                # the GIL so messenger/client threads run on few-core hosts
+                time.sleep(0)
             busy = self.manager.pending_count() > 0
             if not busy:
                 # decided_now needs a device sync; only check when draining

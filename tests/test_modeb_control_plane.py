@@ -141,7 +141,7 @@ def test_migrate_preserves_state_across_processes(servers, client):
     # the complete — poll until the committed record is visible (generous:
     # the migration is several cross-process paxos commits, and the CI box
     # runs every plane on one core)
-    deadline = time.monotonic() + 120
+    deadline = time.monotonic() + 300
     got = set()
     while time.monotonic() < deadline:
         got = set(client.request_actives("mig", force=True))
@@ -192,7 +192,7 @@ def test_coordinator_process_death_fd_failover(servers, client):
     # commits must resume once FD timeout (1s) expires; retry via survivors
     # (generous budget: this runs last in the module, with all prior tests'
     # groups ticking on a box that may have a single core)
-    deadline = time.monotonic() + 120
+    deadline = time.monotonic() + 300
     committed = False
     i = 0
     while time.monotonic() < deadline and not committed:

@@ -109,6 +109,23 @@ def test_nc_add_active_expands_universes_and_migrates():
             time.sleep(0.2)
         assert any(t.get("city") == "paris"
                    for t in getattr(newcomer.app, "db", {}).values())
+
+        # ---- remove an incumbent: the pool shrinks, names drain off it,
+        # but its replica SLOT is retained (universe is append-only) ----
+        rm = client.remove_active("AR0", timeout=60)
+        assert rm["ok"], rm
+        deadline = time.monotonic() + 120
+        got = set()
+        while time.monotonic() < deadline:
+            got = set(client.request_actives("svc", force=True))
+            if "AR0" not in got and len(got) == 3:
+                break
+            time.sleep(0.3)
+        assert "AR0" not in got and len(got) == 3, got
+        assert client.request("svc", b"GET city", timeout=60) == b"paris"
+        # slot order unchanged everywhere: removal never recycles slots
+        for a in ("AR2", "AR4"):
+            assert srv[a].node.members == universe, srv[a].node.members
     finally:
         if client is not None:
             client.close()

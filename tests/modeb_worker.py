@@ -16,7 +16,6 @@ import json
 import os
 import sys
 import threading
-import time
 
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
 
@@ -61,16 +60,17 @@ def main() -> None:
         wal = ModeBLogger(wal_dir, native=False)
         node = ModeBNode(cfg, ids, node_id, app, m, wal=wal)
 
-    stop = threading.Event()
+    # event-driven pumping like the real server (the old fixed 4 ms sleep
+    # capped the only multi-process deployment at ~250 ticks/s)
+    from gigapaxos_tpu.paxos.driver import TickDriver
 
-    def pump() -> None:
-        node.tick()
-        emit("ready")
-        while not stop.is_set():
-            node.tick()
-            time.sleep(0.004)
-
-    threading.Thread(target=pump, daemon=True).start()
+    driver = TickDriver(node, idle_sleep_s=0.02)
+    node.on_work = driver.kick
+    driver.start()
+    if not driver.wait_ready(600):
+        emit("startup_failed")
+        sys.exit(1)
+    emit("ready")
 
     for line in sys.stdin:
         parts = line.strip().split(" ")
@@ -91,7 +91,7 @@ def main() -> None:
             emit("db " + json.dumps(app.db, sort_keys=True))
         elif cmd == "exit":
             break
-    stop.set()
+    driver.stop()
     node.close()
 
 

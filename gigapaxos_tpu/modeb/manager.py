@@ -44,6 +44,7 @@ from ..ops.tick import TickInbox
 from ..types import GroupStatus, NO_REQUEST
 from ..utils.intmap import RowAllocator
 from ..utils.locking import ContendedLock
+from ..utils.reqtrace import tracer as _reqtrace
 from ..paxos import state as st
 from . import wire
 from .kernel import (frame_extract, mirror_apply, node_tick_packed,
@@ -134,6 +135,10 @@ class ModeBNode(ModeBCommon):
         self._pending_out = None
         #: lock-free propose staging, drained at each tick
         self._staged: collections.deque = collections.deque()
+        #: per-request flow tracing (RequestInstrumenter analog); one
+        #: namespace per Mode B UNIVERSE so a forwarded request's
+        #: cross-node hops merge into one timeline in in-process tests
+        self.reqtrace = _reqtrace("mbu:" + ",".join(self.members))
         self._pending_whois: set = set()
         #: decoded frames awaiting the once-per-tick fused mirror apply:
         #: (sender_r, local_rows, frame_row_selector, Frame)
@@ -289,6 +294,8 @@ class ModeBNode(ModeBCommon):
             return None
         rid = self.next_rid()
         self._staged.append((rid, name, payload, callback, stop))
+        if self.reqtrace.enabled:
+            self.reqtrace.event(rid, "staged", name=name, node=self.node_id)
         self._wake()
         return rid
 
@@ -304,6 +311,9 @@ class ModeBNode(ModeBCommon):
                 # the group vanished or stopped between stage and drain
                 if callback is not None:
                     self._held_callbacks.append((callback, rid, None))
+                if self.reqtrace.enabled:
+                    self.reqtrace.event(rid, "failed", name=name,
+                                        node=self.node_id)
                 continue
             rec = ModeBRecord(rid, name, row, payload, stop, callback,
                               self.tick_num)
@@ -337,6 +347,9 @@ class ModeBNode(ModeBCommon):
             "stop": rec.stop,
         })
         self.stats["forwarded"] += 1
+        if self.reqtrace.enabled:
+            self.reqtrace.event(rec.rid, "forwarded",
+                                to=self.members[coord])
 
     def _on_proposal(self, sender: str, p: dict) -> None:
         rid = int(p["rid"])
@@ -543,10 +556,15 @@ class ModeBNode(ModeBCommon):
             return
         response = self.app.execute(name, payload, rid)
         self.stats["executions"] += 1
+        if self.reqtrace.enabled:
+            self.reqtrace.event(rid, "executed", slot=slot,
+                                node=self.node_id)
         if rec is not None and not rec.responded:
             rec.responded = True
             if rec.callback is not None:
                 self._held_callbacks.append((rec.callback, rid, response))
+            if self.reqtrace.enabled:
+                self.reqtrace.event(rid, "responded", node=self.node_id)
 
     def _sweep(self) -> None:
         gone = []

@@ -37,6 +37,7 @@ from ..models.replicable import Replicable
 from ..types import GroupStatus, NO_REQUEST
 from ..utils.intmap import RowAllocator
 from ..utils.locking import ContendedLock, locked as _locked
+from ..utils.reqtrace import tracer as _reqtrace
 from . import state as st
 from ..ops.tick import (HostOutbox, TickInbox, paxos_tick_packed,
                         unpack_outbox)
@@ -132,6 +133,10 @@ class PaxosManager:
         self._staged: collections.deque = collections.deque()
         self._rid_lock = threading.Lock()
         self._draining = False
+        #: per-request flow tracing (RequestInstrumenter analog; no-op
+        #: unless GPTPU_REQTRACE is set — see utils/reqtrace.py).  Each
+        #: manager has its own rid namespace (all start at rid 1).
+        self.reqtrace = _reqtrace(f"pxm:{id(self):x}")
         # Control-plane threads (messenger readers, protocol tasks) call the
         # admin/propose API while a tick driver loops on tick(); one reentrant
         # lock serializes them (the reference synchronizes on the instance map
@@ -375,6 +380,8 @@ class PaxosManager:
             rid = self._next_rid
             self._next_rid += 1
         self._staged.append((rid, name, payload, callback, stop, entry))
+        if self.reqtrace.enabled:
+            self.reqtrace.event(rid, "staged", name=name)
         return rid
 
     @_locked
@@ -408,6 +415,8 @@ class PaxosManager:
         self._row_outstanding[row] += 1
         self._queues[row].append(rid)
         self._last_active[row] = self.tick_num
+        if self.reqtrace.enabled:
+            self.reqtrace.event(rid, "admitted", row=row, entry=entry)
 
     def _drain_staged(self) -> None:
         """Admit every staged proposal (start of each tick, lock held).
@@ -432,6 +441,8 @@ class PaxosManager:
                     if callback is not None:
                         self._held_callbacks.append((callback, rid, None))
                     self.stats["failed_requests"] += 1
+                    if self.reqtrace.enabled:
+                        self.reqtrace.event(rid, "failed", name=name)
                     continue
                 self._admit(rid, name, row, payload, callback, stop, entry)
         finally:
@@ -466,6 +477,8 @@ class PaxosManager:
                 if rec.callback is not None and not rec.responded:
                     self._held_callbacks.append((rec.callback, rid, None))
             self.stats["failed_requests"] += 1
+            if self.reqtrace.enabled:
+                self.reqtrace.event(rid, "failed", reason="group_fenced")
 
     # ------------------------------------------------------------------- tick
     def _build_inbox(self) -> TickInbox:
@@ -503,6 +516,8 @@ class PaxosManager:
                 req[entry, p, row] = rid
                 stp[entry, p, row] = rec.stop
                 take.append((rid, entry, p))
+                if self.reqtrace.enabled:
+                    self.reqtrace.event(rid, "placed", tick=self.tick_num)
             if take:
                 placed.append((row, take))
         self._placed = placed
@@ -625,10 +640,14 @@ class PaxosManager:
         response = self.apps[r].execute(name, rec.payload, rid)
         rec.executed_by.add(r)
         self.stats["executions"] += 1
+        if self.reqtrace.enabled:
+            self.reqtrace.event(rid, "executed", slot=slot, replica=r)
         if r == rec.entry and not rec.responded:
             rec.responded = True
             if rec.callback is not None:
                 self._held_callbacks.append((rec.callback, rid, response))
+            if self.reqtrace.enabled:
+                self.reqtrace.event(rid, "responded", slot=slot)
         members = int(self._n_members_np[row])
         if len(rec.executed_by) >= members and rec.responded:
             del self.outstanding[rid]

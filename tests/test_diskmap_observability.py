@@ -119,3 +119,40 @@ def test_stats_reporter_snapshot_and_log(caplog):
     assert lines, "no periodic dump emitted"
     parsed = json.loads(lines[-1])
     assert parsed["ar"]["ticks"] == 42
+
+
+def test_request_flow_tracing():
+    """RequestInstrumenter analog (paxosutil/RequestInstrumenter.java:25-60):
+    with tracing enabled, a request's full lifecycle timeline is queryable
+    by rid; disabled tracing records nothing (no-op fast path)."""
+    from gigapaxos_tpu.config import GigapaxosTpuConfig
+    from gigapaxos_tpu.models.replicable import KVApp
+    from gigapaxos_tpu.paxos.manager import PaxosManager
+
+    cfg = GigapaxosTpuConfig()
+    m = PaxosManager(cfg, 3, [KVApp() for _ in range(3)])
+    m.create_paxos_instance("svc", [0, 1, 2])
+
+    m.reqtrace.enabled = True
+    try:
+        got = []
+        rid = m.propose("svc", b"PUT a 1", lambda r, resp: got.append(resp))
+        m.run_ticks(6)
+        m.drain_pipeline()
+        assert got == [b"OK"]
+        stages = m.reqtrace.stages(rid)
+        assert stages[0] == "staged"
+        for want in ("admitted", "placed", "executed", "responded"):
+            assert want in stages, (want, stages)
+        dump = m.reqtrace.dump(rid)
+        assert f"rid={rid} staged" in dump and "responded" in dump
+        assert m.reqtrace.latency_s(rid) is not None
+
+        # disabled: records nothing
+        m.reqtrace.enabled = False
+        rid2 = m.propose("svc", b"PUT b 2", lambda r, resp: None)
+        m.run_ticks(6)
+        m.drain_pipeline()
+        assert m.reqtrace.stages(rid2) == []
+    finally:
+        m.reqtrace.enabled = False

@@ -135,10 +135,14 @@ class ModeBNode(ModeBCommon):
         self._pending_out = None
         #: lock-free propose staging, drained at each tick
         self._staged: collections.deque = collections.deque()
-        #: per-request flow tracing (RequestInstrumenter analog); one
-        #: namespace per Mode B UNIVERSE so a forwarded request's
-        #: cross-node hops merge into one timeline in in-process tests
-        self.reqtrace = _reqtrace("mbu:" + ",".join(self.members))
+        #: per-request flow tracing (RequestInstrumenter analog); the
+        #: namespace is the universe's slot-0 owner — identical on every
+        #: node of one universe AND stable under runtime expansion, so a
+        #: forwarded request's cross-node hops merge into one timeline in
+        #: in-process deployments.  (Distinct universes that share a slot-0
+        #: id in one process share a namespace; their slot-tagged rids can
+        #: then collide — acceptable for a debug facility.)
+        self.reqtrace = _reqtrace(f"mbu:{self.members[0]}")
         self._pending_whois: set = set()
         #: decoded frames awaiting the once-per-tick fused mirror apply:
         #: (sender_r, local_rows, frame_row_selector, Frame)

@@ -38,6 +38,11 @@ from ..types import GroupStatus, NO_REQUEST
 from ..utils.intmap import RowAllocator
 from ..utils.locking import ContendedLock, locked as _locked
 from ..utils.reqtrace import tracer as _reqtrace
+
+#: process-wide manager counter for trace namespaces (never reused)
+import itertools as _itertools
+
+_MGR_SEQ = _itertools.count()
 from . import state as st
 from ..ops.tick import (HostOutbox, TickInbox, paxos_tick_packed,
                         unpack_outbox)
@@ -135,8 +140,9 @@ class PaxosManager:
         self._draining = False
         #: per-request flow tracing (RequestInstrumenter analog; no-op
         #: unless GPTPU_REQTRACE is set — see utils/reqtrace.py).  Each
-        #: manager has its own rid namespace (all start at rid 1).
-        self.reqtrace = _reqtrace(f"pxm:{id(self):x}")
+        #: manager has its own rid namespace (all start at rid 1), drawn
+        #: from a monotonic counter (id() would be reused after GC).
+        self.reqtrace = _reqtrace(f"pxm:{next(_MGR_SEQ)}")
         # Control-plane threads (messenger readers, protocol tasks) call the
         # admin/propose API while a tick driver loops on tick(); one reentrant
         # lock serializes them (the reference synchronizes on the instance map
@@ -399,6 +405,8 @@ class PaxosManager:
         with self._rid_lock:
             rid = self._next_rid
             self._next_rid += 1
+        if self.reqtrace.enabled:
+            self.reqtrace.event(rid, "staged", name=name, path="slow")
         self._admit(rid, name, row, payload, callback, stop, entry)
         return rid
 

@@ -73,23 +73,29 @@ def _store() -> _Store:
 
 
 class RequestTracer:
-    """A namespace-scoped view over the process-global trace store."""
+    """A namespace-scoped view over the process-global trace store.
+
+    ``enabled`` reads the process default (GPTPU_REQTRACE) unless this view
+    was explicitly toggled — setting it affects ONLY this view, so enabling
+    tracing on one manager neither records nor evicts for the others."""
 
     def __init__(self, ns: str):
         self.ns = ns
         self._st = _store()
+        self._override: "bool | None" = None
 
     @property
     def enabled(self) -> bool:
-        return self._st.enabled
+        return (self._st.enabled if self._override is None
+                else self._override)
 
     @enabled.setter
     def enabled(self, on: bool) -> None:
-        self._st.enabled = bool(on)
+        self._override = bool(on)
 
     # ------------------------------------------------------------- recording
     def event(self, rid: int, stage: str, **detail) -> None:
-        if not self._st.enabled:
+        if not self.enabled:  # view override first, then process default
             return
         self._st.event(self.ns, rid, stage, detail)
 

@@ -26,7 +26,12 @@ def test_all_up_then_crash_then_recover():
     ids = ["A", "B", "C"]
     nm, ms, fds = cluster(ids)
     try:
-        time.sleep(0.3)
+        # poll-with-deadline, not a fixed sleep: pinger threads can starve
+        # for hundreds of ms when the whole suite shares one core
+        deadline = time.monotonic() + 20
+        while (not all(fds["A"].is_node_up(n) for n in ids)
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
         assert all(fds["A"].is_node_up(n) for n in ids)
         assert list(fds["A"].alive_mask(ids)) == [True, True, True]
 
@@ -34,7 +39,7 @@ def test_all_up_then_crash_then_recover():
         port_b = ms["B"].port
         fds["B"].close()
         ms["B"].close()
-        deadline = time.monotonic() + 5
+        deadline = time.monotonic() + 20
         while fds["A"].is_node_up("B") and time.monotonic() < deadline:
             time.sleep(0.05)
         assert not fds["A"].is_node_up("B")
@@ -48,7 +53,7 @@ def test_all_up_then_crash_then_recover():
         fds["B"] = FailureDetection(
             ms["B"], ["A", "C"], ping_interval_s=0.05, timeout_s=0.4
         )
-        deadline = time.monotonic() + 5
+        deadline = time.monotonic() + 20
         while not fds["A"].is_node_up("B") and time.monotonic() < deadline:
             time.sleep(0.05)
         assert fds["A"].is_node_up("B")

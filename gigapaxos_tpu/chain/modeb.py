@@ -264,6 +264,8 @@ class ChainModeBNode(ModeBCommon):
                           % max(anti_entropy_every, 1))
         self._force_full = True
         self._placed: list = []
+        #: lock-free propose staging, drained at each tick
+        self._staged: collections.deque = collections.deque()
         self._pending_whois: set = set()
         self._pending_mirror: list = []
         self._frame_applied_tick: Dict[int, int] = {}
@@ -386,23 +388,44 @@ class ChainModeBNode(ModeBCommon):
     def propose(self, name: str, payload: bytes,
                 callback: Optional[Callable[[int, Optional[bytes]], None]] = None,
                 stop: bool = False) -> Optional[int]:
-        with self.lock:
+        """Lock-free fast path like the paxos planes (see
+        paxos/manager.propose): stage for the next tick's drain; the
+        existence/fenced pre-checks are racy reads and the authoritative
+        outcome rides the callback.  A racy negative (unknown or fenced)
+        re-checks under the lock before rejecting — a recycled row can be
+        visible in the row table before the old occupant's stopped flag is
+        discarded."""
+        row = self.rows.row(name)  # racy read: benign for the POSITIVE case
+        if row is None or row in self._stopped_rows:
+            with self.lock:
+                row = self.rows.row(name)
+                if row is None or row in self._stopped_rows:
+                    if callback is not None:
+                        self._held_callbacks.append((callback, -1, None))
+                    return None
+        rid = self.next_rid()
+        self._staged.append((rid, name, payload, callback, stop))
+        self._wake()
+        return rid
+
+    def _drain_staged(self) -> None:
+        """Admit staged proposals (start of each tick, lock held): queue
+        on the group's row — the placement loop that runs right after
+        already forwards every queued rid to a remote head."""
+        while True:
+            try:
+                rid, name, payload, callback, stop = self._staged.popleft()
+            except IndexError:
+                return
             row = self.rows.row(name)
             if row is None or row in self._stopped_rows:
                 if callback is not None:
-                    self._held_callbacks.append((callback, -1, None))
-                return None
-            rid = self.next_rid()
+                    self._held_callbacks.append((callback, rid, None))
+                continue
             rec = ChainBRecord(rid, name, row, payload, stop, callback,
                                self.tick_num)
             self.outstanding[rid] = rec
-            head = self._head_of(row)
-            if head == self.r or head is None:
-                self._queues[row].append(rid)
-            else:
-                self._forward(rec, head)
-        self._wake()
-        return rid
+            self._queues[row].append(rid)
 
     def propose_stop(self, name: str, payload: bytes = b"", callback=None):
         return self.propose(name, payload, callback, stop=True)
@@ -472,6 +495,7 @@ class ChainModeBNode(ModeBCommon):
         return out
 
     def _build_inbox(self) -> ChainInbox:
+        self._drain_staged()
         req, stp = self._in_req, self._in_stp
         for _row, take in self._placed:
             for _rid, p in take:
@@ -839,7 +863,7 @@ class ChainModeBNode(ModeBCommon):
     # ------------------------------------------------------------ driver shim
     def pending_count(self) -> int:
         with self.lock:
-            n = sum(len(q) for q in self._queues.values())
+            n = sum(len(q) for q in self._queues.values()) + len(self._staged)
             n += sum(1 for rec in self.outstanding.values()
                      if not rec.responded)
             n += len(self._await_commit)

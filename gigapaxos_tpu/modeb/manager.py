@@ -290,12 +290,17 @@ class ModeBNode(ModeBCommon):
         """Lock-free fast path: stage the request for the next tick's drain
         (see paxos/manager.propose — the existence/fenced pre-checks are
         racy reads; the authoritative outcome rides the callback)."""
-        row = self.rows.row(name)  # racy read: benign
+        row = self.rows.row(name)  # racy read: benign for the POSITIVE case
         if row is None or row in self._stopped_rows:
-            if callback is not None:
-                with self.lock:
-                    self._held_callbacks.append((callback, -1, None))
-            return None
+            # a racy negative re-checks under the lock before rejecting: a
+            # recycled row can be visible in the row table before the old
+            # occupant's stopped flag is discarded
+            with self.lock:
+                row = self.rows.row(name)
+                if row is None or row in self._stopped_rows:
+                    if callback is not None:
+                        self._held_callbacks.append((callback, -1, None))
+                    return None
         rid = self.next_rid()
         self._staged.append((rid, name, payload, callback, stop))
         if self.reqtrace.enabled:

@@ -52,6 +52,7 @@ from ..net.transport import SendFailure
 from ..types import GroupStatus, NO_REQUEST
 from ..utils.intmap import RowAllocator
 from ..utils.locking import ContendedLock
+from ..utils.reqtrace import tracer as _reqtrace
 from . import state as st
 from .tick import ChainInbox, chain_tick_impl
 
@@ -266,6 +267,8 @@ class ChainModeBNode(ModeBCommon):
         self._placed: list = []
         #: lock-free propose staging, drained at each tick
         self._staged: collections.deque = collections.deque()
+        #: per-request flow tracing (see modeb/manager.py): universe-scoped
+        self.reqtrace = _reqtrace(f"chu:{self.members[0]}")
         self._pending_whois: set = set()
         self._pending_mirror: list = []
         self._frame_applied_tick: Dict[int, int] = {}
@@ -405,6 +408,8 @@ class ChainModeBNode(ModeBCommon):
                     return None
         rid = self.next_rid()
         self._staged.append((rid, name, payload, callback, stop))
+        if self.reqtrace.enabled:
+            self.reqtrace.event(rid, "staged", name=name, node=self.node_id)
         self._wake()
         return rid
 
@@ -425,6 +430,9 @@ class ChainModeBNode(ModeBCommon):
             rec = ChainBRecord(rid, name, row, payload, stop, callback,
                                self.tick_num)
             self.outstanding[rid] = rec
+            if self.reqtrace.enabled:
+                self.reqtrace.event(rid, "admitted", row=row,
+                                    node=self.node_id)
             self._queues[row].append(rid)
 
     def propose_stop(self, name: str, payload: bytes = b"", callback=None):

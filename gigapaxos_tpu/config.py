@@ -55,11 +55,40 @@ class PaxosTuning:
     # execution) while the device computes tick N and the WAL drains.
     # Costs one tick of response latency; checkpoints drain synchronously.
     pipeline_ticks: bool = False
+    # Compacted outbox: the device prefix-sum-compacts the executed
+    # decision stream to O(decisions) instead of shipping the full
+    # O(R*W*G) outbox, and the manager's host loop goes vectorized
+    # (bulk store + execute_batch).  Required to run the REAL manager
+    # stack at 100k-1M groups; leave off for tiny-G control planes where
+    # the full outbox is cheaper than a second compiled program.
+    compact_outbox: bool = False
+    # Per-tick cap on executions the device extracts (0 = auto: 2 *
+    # max_groups, min 4096).  Bounds the compacted transfer; overflow is
+    # deferred in-ring, not dropped (lossless backpressure).
+    exec_budget: int = 0
+    # Compacted laggard list size (lag >= window -> checkpoint transfer).
+    lag_budget: int = 1024
+    # Compact path: automatically run checkpoint transfers for replicas the
+    # device reports >= window behind (the reference's laggards repair
+    # automatically too, via handleSyncDecisionsPacket -> checkpoint
+    # transfer, PaxosInstanceStateMachine.java:1852).  Transfers are
+    # journaled (OP_SYNC) so WAL replay reproduces them.
+    auto_laggard_sync: bool = True
+    # Bulk request-store capacity (0 = auto: 4 * max_groups, min 65536,
+    # rounded up to a power of two).  Bounds requests in flight on the
+    # propose_bulk path (MAX_OUTSTANDING_REQUESTS analog).
+    bulk_capacity: int = 0
 
     def __post_init__(self) -> None:
         if self.window < 2 or (self.window & (self.window - 1)):
             raise ValueError(
                 f"window must be a power of two >= 2, got {self.window}"
+            )
+        if self.compact_outbox and self.proposals_per_tick > 31:
+            # taken_bits packs the P intake slots into one int32 lane
+            raise ValueError(
+                "compact_outbox packs intake acceptance into 31 bits; "
+                f"proposals_per_tick={self.proposals_per_tick} exceeds it"
             )
 
 

@@ -107,9 +107,21 @@ def _lexmax(n, c, axis):
     return jnp.squeeze(nmax, axis=axis), cmax
 
 
-def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1):
+def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
+                    exec_budget: int = 0):
     """Un-jitted tick body (jit/shard it yourself; `paxos_tick` below is the
     ready-made single-program jit with state donation).
+
+    exec_budget: 0 = unlimited.  > 0 caps the TOTAL executions extracted
+    this tick across all (replica, group) pairs, cutting each group's
+    in-order run at a prefix (flat enumeration order is (r, j, g), so the
+    per-group prefix property is preserved).  Decisions beyond the budget
+    stay in the decision ring — ``exec_slot`` does not advance past them,
+    the window-arithmetic dwrite guard keeps them from being overwritten,
+    and a full window throttles intake — so the cap is lossless
+    backpressure, not drop.  This is what makes a *bounded* compacted
+    outbox transfer safe (see :func:`paxos_tick_compact_impl`): the host
+    never needs more than ``exec_budget`` execution records per tick.
 
     own_row: -1 for Mode A (all rows authoritative: the whole replica set is
     one device program, so same-tick cross-row writes ARE the messages).
@@ -419,6 +431,21 @@ def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1):
     stop_hit = run & Dstop
     stop_before2 = jnp.cumsum(stop_hit.astype(I32), axis=1) - stop_hit.astype(I32)
     exec_mask = run & (stop_before2 == 0)
+    if exec_budget > 0:
+        # global budget cap: rank would-be executions in (j, r, g) order —
+        # every replica's FIRST pending slot outranks anyone's second — and
+        # keep the first `exec_budget`.  For fixed (r, g) the rank grows
+        # with j, so the kept set is a per-group run prefix (in-order
+        # execution preserved; the rest defers).  Fairness across the
+        # replica axis matters: ranking (r, j, g)-first starves the highest
+        # replica slots under sustained pressure until they fall > W behind
+        # and their missed slots rotate out of every decision ring.
+        em_t = exec_mask.transpose(1, 0, 2)  # [W, R, G]
+        fi = em_t.reshape(-1).astype(I32)
+        rank = (jnp.cumsum(fi) - fi).reshape(em_t.shape)
+        exec_mask = exec_mask & (
+            rank.transpose(1, 0, 2) < exec_budget
+        )
     n_exec = jnp.sum(exec_mask, axis=1).astype(I32)  # [R, G]
     exec_req_out = jnp.where(exec_mask, Dreq, NO_REQUEST)
     exec_stop_out = exec_mask & Dstop
@@ -480,7 +507,8 @@ def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1):
     return new_state, outbox
 
 
-paxos_tick = jax.jit(paxos_tick_impl, donate_argnums=(0,), static_argnums=(2,))
+paxos_tick = jax.jit(paxos_tick_impl, donate_argnums=(0,),
+                     static_argnums=(2, 3))
 
 
 class HostOutbox(NamedTuple):
@@ -533,15 +561,156 @@ def unpack_outbox(flat, R: int, P: int, W: int, G: int) -> HostOutbox:
     )
 
 
-def _paxos_tick_packed_impl(state, inbox: TickInbox, own_row: int = -1):
-    state, out = paxos_tick_impl(state, inbox, own_row)
+def _paxos_tick_packed_impl(state, inbox: TickInbox, own_row: int = -1,
+                            exec_budget: int = 0):
+    state, out = paxos_tick_impl(state, inbox, own_row, exec_budget)
     return state, pack_outbox_impl(out)
 
 
-#: fused tick + outbox pack: one dispatch, one device->host buffer
+#: fused tick + outbox pack: one dispatch, one device->host buffer.
+#: exec_budget matters even on this full-outbox path: WAL replay of a run
+#: that ticked with a budget must evolve state identically.
 paxos_tick_packed = jax.jit(
-    _paxos_tick_packed_impl, donate_argnums=(0,), static_argnums=(2,)
+    _paxos_tick_packed_impl, donate_argnums=(0,), static_argnums=(2, 3)
 )
+
+
+# --------------------------------------------------------------------------
+# Compacted outbox: the bounded-transfer tick for the at-scale host path.
+#
+# The full outbox is O(R*W*G) — ~220 MB/tick at the 1M-group design point,
+# which would drown the host link no matter how fast the host loop is.  At
+# steady state the host only needs (a) the executed decision stream, whose
+# length the exec budget bounds, (b) which placed intake was taken (P bits
+# per (r, g)), (c) the rare laggards needing checkpoint transfer, and (d)
+# the decision counter.  The device compacts exactly that with an on-device
+# prefix-sum scatter (the TPU-native analog of the reference shipping
+# individual DECISION packets instead of whole acceptor state,
+# PaxosInstanceStateMachine.java:1755-1842), so the device->host transfer is
+# O(decisions), not O(state).
+# --------------------------------------------------------------------------
+
+
+class CompactHostOutbox(NamedTuple):
+    """Host view of the compacted tick (all numpy, one transfer).
+
+    Executed entries appear in flat (r, j, g) order — per (replica, group)
+    they are slot-ordered, which is the only order execution needs.
+    ``n_exec == budget`` means the budget may have bitten; deferred work
+    arrives on later ticks (see exec_budget in :func:`paxos_tick_impl`).
+    """
+
+    n_exec: int
+    decided_total: int
+    lag_n: int            # total laggards (may exceed the recorded list)
+    taken_bits: "np.ndarray"  # i32 [R, G], bit p = inbox slot p was taken
+    e_rid: "np.ndarray"   # i32 [n_exec]
+    e_rep: "np.ndarray"   # i32 [n_exec]
+    e_row: "np.ndarray"   # i32 [n_exec]
+    e_slot: "np.ndarray"  # i32 [n_exec]
+    e_stop: "np.ndarray"  # bool [n_exec]
+    l_rep: "np.ndarray"   # i32 [min(lag_n, lag_budget)]
+    l_row: "np.ndarray"   # i32 [min(lag_n, lag_budget)]
+
+
+def _compact_outbox_impl(out: TickOutbox, exec_budget: int,
+                         lag_budget: int) -> jnp.ndarray:
+    R, W, G = out.exec_req.shape
+    P = out.intake_taken.shape[1]
+    E, Lb = exec_budget, lag_budget
+    ji = jnp.arange(W, dtype=I32)[None, :, None]
+    mask = ji < out.exec_count[:, None, :]  # [R, W, G] (post-cap)
+    mf = mask.reshape(-1)
+    mi = mf.astype(I32)
+    rank = jnp.cumsum(mi) - mi
+    idx = jnp.where(mf, rank, E)  # E -> dropped by mode="drop"
+
+    def scat(vals):
+        return jnp.zeros((E,), I32).at[idx].set(
+            vals.reshape(-1).astype(I32), mode="drop"
+        )
+
+    slot = out.exec_base[:, None, :] + ji
+    rep = jnp.broadcast_to(jnp.arange(R, dtype=I32)[:, None, None], (R, W, G))
+    row = jnp.broadcast_to(jnp.arange(G, dtype=I32)[None, None, :], (R, W, G))
+    meta = rep | (out.exec_stop.astype(I32) << 8)
+    n_exec = jnp.sum(mi)
+    # intake: P bits per (r, g) — placed-and-taken; host knows what it placed
+    pb = jnp.arange(P, dtype=I32)[None, :, None]
+    taken_bits = jnp.sum(out.intake_taken.astype(I32) << pb, axis=1)  # [R,G]
+    # laggards needing checkpoint transfer (lag >= W): compacted pair list
+    lmask = (out.lag >= W).reshape(-1)
+    li = lmask.astype(I32)
+    lrank = jnp.cumsum(li) - li
+    lidx = jnp.where(lmask, lrank, Lb)
+    rep2 = jnp.broadcast_to(jnp.arange(R, dtype=I32)[:, None], (R, G))
+    row2 = jnp.broadcast_to(jnp.arange(G, dtype=I32)[None, :], (R, G))
+
+    def lscat(vals):
+        return jnp.zeros((Lb,), I32).at[lidx].set(
+            vals.reshape(-1), mode="drop"
+        )
+
+    header = jnp.stack([
+        n_exec,
+        jnp.sum(out.decided_now),
+        jnp.sum(li),
+    ]).astype(I32)
+    return jnp.concatenate([
+        header,
+        taken_bits.reshape(-1),
+        scat(out.exec_req),
+        scat(meta),
+        scat(slot),
+        scat(row),
+        lscat(rep2),
+        lscat(row2),
+    ])
+
+
+def _paxos_tick_compact_impl(state, inbox: TickInbox, own_row: int,
+                             exec_budget: int, lag_budget: int):
+    state, out = paxos_tick_impl(state, inbox, own_row, exec_budget)
+    return state, _compact_outbox_impl(out, exec_budget, lag_budget)
+
+
+#: fused tick + budgeted on-device compaction: one dispatch, one
+#: O(budget) device->host buffer
+paxos_tick_compact = jax.jit(
+    _paxos_tick_compact_impl, donate_argnums=(0,), static_argnums=(2, 3, 4)
+)
+
+
+def unpack_compact(flat, R: int, G: int, exec_budget: int,
+                   lag_budget: int) -> CompactHostOutbox:
+    """Host-side inverse of :func:`_compact_outbox_impl` (zero-copy views
+    into the one transferred buffer)."""
+    flat = np.asarray(flat)
+    E, Lb = exec_budget, lag_budget
+    n_exec, decided_total, lag_n = (int(flat[0]), int(flat[1]), int(flat[2]))
+    o = 3
+    taken_bits = flat[o:o + R * G].reshape(R, G)
+    o += R * G
+    e_rid = flat[o:o + n_exec]; o += E
+    e_meta = flat[o:o + n_exec]; o += E
+    e_slot = flat[o:o + n_exec]; o += E
+    e_row = flat[o:o + n_exec]; o += E
+    ln = min(lag_n, Lb)
+    l_rep = flat[o:o + ln]; o += Lb
+    l_row = flat[o:o + ln]
+    return CompactHostOutbox(
+        n_exec=n_exec,
+        decided_total=decided_total,
+        lag_n=lag_n,
+        taken_bits=taken_bits,
+        e_rid=e_rid,
+        e_rep=e_meta & 0xFF,
+        e_row=e_row,
+        e_slot=e_slot,
+        e_stop=(e_meta >> 8).astype(bool),
+        l_rep=l_rep,
+        l_row=l_row,
+    )
 
 
 def make_inbox(n_replicas: int, n_groups: int, per_tick: int) -> TickInbox:

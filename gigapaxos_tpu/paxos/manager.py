@@ -44,8 +44,10 @@ import itertools as _itertools
 
 _MGR_SEQ = _itertools.count()
 from . import state as st
-from ..ops.tick import (HostOutbox, TickInbox, paxos_tick_packed,
-                        unpack_outbox)
+from .bulkstore import BulkOverrun, BulkStore
+from ..ops.tick import (CompactHostOutbox, HostOutbox, TickInbox,
+                        paxos_tick_compact, paxos_tick_packed,
+                        unpack_compact, unpack_outbox)
 
 
 @dataclass
@@ -124,6 +126,29 @@ class PaxosManager:
         # (round-2 profile: ~230us per state.n_members[row] lookup).
         self._member_np = np.zeros((self.R, self.G), bool)
         self._n_members_np = np.zeros(self.G, np.int32)
+        # further host mirrors for the vectorized (bulk/compact) path:
+        # stopped flags, row->name, member bitmask, member-ordinal table
+        self._stopped_np = np.zeros(self.G, bool)
+        self._row_name_np = np.empty(self.G, object)
+        self._member_bits = np.zeros(self.G, np.int64)
+        self._member_ord = None  # lazy [R, G] cumulative member ordinal
+        # ---- compacted-outbox / bulk-propose machinery ----
+        self._use_compact = bool(cfg.paxos.compact_outbox)
+        self._exec_budget = cfg.paxos.exec_budget or max(4096, 2 * self.G)
+        self._lag_budget = max(64, cfg.paxos.lag_budget)
+        bc = cfg.paxos.bulk_capacity or max(1 << 16, 4 * self.G)
+        self._bulk_cap = 1 << (bc - 1).bit_length()
+        self.bulk: Optional[BulkStore] = None  # lazy (most managers: unused)
+        self._bulk_chunks: list = []  # FIFO of staged rid arrays
+        self._bulk_leftover = np.zeros(0, np.int64)  # queued, not yet placed
+        self._bulk_placed = None  # (rids, entries, ps, rows) of last tick
+        self._lag_pending = (np.zeros(0, np.int64), np.zeros(0, np.int64))
+        # first-occurrence scratch (generation-tagged so no per-tick clear)
+        self._scr_pos = np.zeros(self.R * self.G, np.int64)
+        self._scr_gen = np.zeros(self.R * self.G, np.int64)
+        self._scr2_pos = None  # store-capacity scratch, allocated w/ store
+        self._scr2_gen = None
+        self._gen = 0
         # preallocated inbox staging buffers; entries placed last tick are
         # zeroed lazily at the next build instead of reallocating R*P*G
         self._in_req = np.zeros((self.R, self.P, self.G), np.int32)
@@ -171,13 +196,30 @@ class PaxosManager:
             mask,
             np.array([epoch], np.int32),
         )
-        self._member_np[:, row] = mask[0]
-        self._n_members_np[row] = mask[0].sum()
+        self._set_member_row(row, mask[0], name)
         self._stopped_rows.discard(row)
+        self._stopped_np[row] = False
         self._last_active[row] = self.tick_num
         if self.wal is not None:
             self.wal.log_create(name, members, epoch)
         return True
+
+    def _set_member_row(self, row, mask, name) -> None:
+        """Refresh every host mirror of one row's config (mask: [R] bool)."""
+        self._member_np[:, row] = mask
+        self._n_members_np[row] = mask.sum()
+        self._member_bits[row] = int(
+            np.bitwise_or.reduce((1 << np.where(mask)[0]).astype(np.int64))
+        ) if mask.any() else 0
+        self._row_name_np[row] = name
+        self._member_ord = None
+
+    def _clear_member_rows(self, rows) -> None:
+        self._member_np[:, rows] = False
+        self._n_members_np[rows] = 0
+        self._member_bits[rows] = 0
+        self._row_name_np[rows] = None
+        self._member_ord = None
 
     @_locked
     def remove_paxos_instance(self, name: str) -> bool:
@@ -196,12 +238,16 @@ class PaxosManager:
         # against a future occupant
         self.drain_pipeline()
         self.state = st.free_groups(self.state, np.array([row], np.int32))
-        self._member_np[:, row] = False
-        self._n_members_np[row] = 0
+        self._clear_member_rows([row])
         self.rows.free(name)
         self._fail_queued(row)
         self._purge_row_outstanding(row)
+        if self.bulk is not None:
+            self.stats["failed_requests"] += self.bulk.fail(
+                np.nonzero(self.bulk.valid & (self.bulk.row == row))[0]
+            )
         self._stopped_rows.discard(row)
+        self._stopped_np[row] = False
         if self.wal is not None:
             self.wal.log_remove(name)
         return True
@@ -273,6 +319,20 @@ class PaxosManager:
         exec_slot = np.array(self.state.exec_slot)
         next_slot = np.array(self.state.next_slot)
         member = self._member_np
+        # rows referenced by live/queued bulk requests are not pausable
+        # (bulk requests are invisible to _row_outstanding)
+        bulk_ref = None
+        if self.bulk is not None and (
+            self.bulk.n_live or self._bulk_leftover.size or self._bulk_chunks
+        ):
+            bulk_ref = np.zeros(self.G, bool)
+            bulk_ref[self.bulk.row[self.bulk.valid]] = True
+            parts = ([self._bulk_leftover] if self._bulk_leftover.size
+                     else []) + self._bulk_chunks
+            if parts:
+                q = np.concatenate(parts)
+                qi, qlive = self.bulk.lookup(q)
+                bulk_ref[self.bulk.row[qi[qlive]]] = True
         # coldest first so eviction keeps the working set hot
         cands = sorted(
             self.rows.items(), key=lambda kv: self._last_active[kv[1]]
@@ -286,6 +346,8 @@ class PaxosManager:
                     break  # sorted: everything later is hotter
                 continue
             if self._queues.get(row) or self._row_outstanding[row] > 0:
+                continue
+            if bulk_ref is not None and bulk_ref[row]:
                 continue
             ms = np.where(member[:, row])[0]
             if len(ms) == 0:
@@ -313,11 +375,11 @@ class PaxosManager:
             self._paused[name] = hri
             rows_to_free.append(row)
         self.state = st.free_groups(self.state, np.array(rows_to_free, np.int32))
-        self._member_np[:, rows_to_free] = False
-        self._n_members_np[rows_to_free] = 0
+        self._clear_member_rows(rows_to_free)
         for name in names:
             row = self.rows.free(name)
             self._stopped_rows.discard(row)
+            self._stopped_np[row] = False
             self._queues.pop(row, None)
         self.stats["paused"] += len(names)
 
@@ -335,11 +397,11 @@ class PaxosManager:
             self.state, np.array([row], np.int32), mask,
             np.array([hri["epoch"]], np.int32),
         )
-        self._member_np[:, row] = mask[0]
-        self._n_members_np[row] = mask[0].sum()
+        self._set_member_row(row, mask[0], name)
         self.state = st.hot_restore(self.state, row, hri)
         if hri.get("stopped"):
             self._stopped_rows.add(row)
+            self._stopped_np[row] = True
         self._last_active[row] = self.tick_num
         self.stats["unpaused"] += 1
         if self.wal is not None:
@@ -459,6 +521,128 @@ class PaxosManager:
     def propose_stop(self, name: str, payload: bytes = b"", callback=None):
         return self.propose(name, payload, callback, stop=True)
 
+    # -------------------------------------------------------- bulk (fast path)
+    def _ensure_bulk(self) -> BulkStore:
+        if self.bulk is None:
+            self.bulk = BulkStore(self._bulk_cap)
+            self._scr2_pos = np.zeros(self._bulk_cap, np.int64)
+            self._scr2_gen = np.zeros(self._bulk_cap, np.int64)
+        return self.bulk
+
+    def _member_ordinals(self) -> np.ndarray:
+        """[R, G] ordinal of each member within its group (cached; config
+        changes invalidate)."""
+        if self._member_ord is None:
+            m = self._member_np.astype(np.int32)
+            self._member_ord = np.cumsum(m, axis=0) - m
+        return self._member_ord
+
+    @_locked
+    def propose_bulk(self, rows, payloads, stops=None) -> np.ndarray:
+        """Vectorized propose: admit one request per entry of ``rows`` (row
+        indices into the group table) in a single columnar operation.
+
+        ``payloads``: one bytes object (shared by all — generated-load
+        fan-out) or a sequence of per-request bytes.  Returns the assigned
+        rid array (int64), -1 where the target row was unknown/stopped.
+        No per-request callbacks ride this path: completion is observable
+        through :meth:`bulk_stats` (the open-loop TESTPaxosClient model,
+        ``testing/TESTPaxosClient.java:59``); response payloads for entry
+        replicas are retained in the store until the request is freed.
+        """
+        store = self._ensure_bulk()
+        rows = np.asarray(rows, np.int64)
+        out = np.full(len(rows), -1, np.int64)
+        ok = (self._n_members_np[rows] > 0) & ~self._stopped_np[rows]
+        if stops is not None:
+            stops = np.asarray(stops, bool)
+        if not ok.all():
+            self.stats["failed_requests"] += int((~ok).sum())
+            rows = rows[ok]
+            if stops is not None:
+                stops = stops[ok]
+            if not isinstance(payloads, (bytes, bytearray)):
+                payloads = [p for p, o in zip(payloads, ok) if o]
+        n = len(rows)
+        if n == 0:
+            return out
+        # bounded-outstanding backpressure: admit only what the store
+        # window can hold; the remainder returns -1 (retry later) instead
+        # of raising mid-batch (MAX_OUTSTANDING_REQUESTS throttle analog)
+        store._advance_lo()
+        with self._rid_lock:
+            rid0 = self._next_rid
+            if store.n_live == 0:
+                store.lo = rid0  # empty store: no slot can collide
+                room = store.cap
+            else:
+                room = store.cap - (rid0 - store.lo)
+            n_adm = max(0, min(n, room))
+            self._next_rid += n_adm
+        if self._next_rid >= 2**31:
+            raise OverflowError("rid space exhausted (int32 device ids)")
+        if n_adm == 0:
+            self.stats["backpressured"] += n
+            return out
+        if n_adm < n:
+            self.stats["backpressured"] += n - n_adm
+            rows = rows[:n_adm]
+            if stops is not None:
+                stops = stops[:n_adm]
+            if not isinstance(payloads, (bytes, bytearray)):
+                payloads = payloads[:n_adm]
+        # spread entry duty across each group's members by rid rotation
+        nm = self._n_members_np[rows]
+        k = ((rid0 + np.arange(n_adm)) % nm).astype(np.int32)
+        om = self._member_ordinals()
+        entries = np.zeros(n_adm, np.int32)
+        for r in range(self.R):
+            sel = self._member_np[r, rows] & (om[r, rows] == k)
+            entries[sel] = r
+        rids = store.admit(rid0, rows.astype(np.int32), entries, stops,
+                           payloads)
+        self._bulk_chunks.append(rids)
+        self._last_active[rows] = self.tick_num
+        out[np.nonzero(ok)[0][:n_adm]] = rids
+        return out
+
+    def bulk_response(self, rid: int):
+        """Response payload of an entry-replica-completed bulk request.
+        Retained until the request is fully executed everywhere and freed;
+        None once freed (or unknown) — poll before the request completes on
+        the LAST member, or use the scalar propose path for per-request
+        callbacks.  Log-before-respond holds here exactly as for scalar
+        callbacks: nothing is observable until the WAL covering the
+        request's tick is fsynced."""
+        if self.wal is not None and not self.wal.is_synced():
+            return None
+        s = self.bulk
+        if s is None:
+            return None
+        i = rid & s.mask
+        if s.valid[i] and s.rid[i] == rid:
+            return s.response[i]
+        return None
+
+    def bulk_stats(self) -> dict:
+        s = self.bulk
+        return {
+            "live": 0 if s is None else s.n_live,
+            "done": 0 if s is None else s.done,
+            "queued": int(self._bulk_leftover.size)
+            + sum(len(c) for c in self._bulk_chunks),
+        }
+
+    def _first_occurrence(self, keys: np.ndarray, scr_pos, scr_gen) -> np.ndarray:
+        """Mask of first occurrences of each key, order-preserving, O(n) —
+        no sort (argsort/unique on the hot path was the round-3 lesson)."""
+        self._gen += 1
+        pos = np.arange(len(keys))
+        # reversed scatter: the FIRST occurrence is written last and wins
+        scr_pos[keys[::-1]] = pos[::-1]
+        scr_gen[keys[::-1]] = self._gen
+        return (scr_gen[keys] == self._gen) & (scr_pos[keys] == pos)
+
     def _purge_row_outstanding(self, row: int) -> None:
         """Drop placed-but-unfinished records of a removed group.  Without
         this the row's outstanding counter stays >0 forever (free_groups
@@ -497,6 +681,11 @@ class PaxosManager:
             for _rid, entry, p in take:
                 req[entry, p, _row] = 0
                 stp[entry, p, _row] = False
+        if self._bulk_placed is not None:
+            _r, _e, _p, _rw = self._bulk_placed
+            req[_e, _p, _rw] = 0
+            stp[_e, _p, _rw] = False
+            self._bulk_placed = None
         placed = []
         for row, q in self._queues.items():
             used = collections.Counter()
@@ -529,49 +718,144 @@ class PaxosManager:
             if take:
                 placed.append((row, take))
         self._placed = placed
+        self._place_bulk(req, stp, placed)
         # hand the jit fresh copies (the staging buffers get mutated next
         # tick; a zero-copy dispatch aliasing them would race the async
         # step); the WAL reads inbox.alive without a device round-trip
         return TickInbox(req.copy(), stp.copy(), self.alive.copy())
 
+    def _place_bulk(self, req, stp, placed) -> None:
+        """Vectorized placement of the bulk queue into the staging arrays:
+        first-occurrence per (entry, row) key (one new proposal per entry
+        slot per tick on this path — at operating G that saturates the
+        window), remainder stays queued in arrival order."""
+        if not self._bulk_chunks and not self._bulk_leftover.size:
+            return
+        parts = ([self._bulk_leftover] if self._bulk_leftover.size else []) \
+            + self._bulk_chunks
+        self._bulk_chunks = []
+        q = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        store = self.bulk
+        idx, live = store.lookup(q)
+        if not live.all():
+            q, idx = q[live], idx[live]
+        rows = store.row[idx]
+        # rows gone dead under queued requests (removed/stopped): drop them
+        bad = (self._n_members_np[rows] == 0) | self._stopped_np[rows]
+        if bad.any():
+            store.fail(idx[bad])
+            self.stats["failed_requests"] += int(bad.sum())
+            q, idx, rows = q[~bad], idx[~bad], rows[~bad]
+        if not len(q):
+            self._bulk_leftover = np.zeros(0, np.int64)
+            return
+        entries = store.entry[idx]
+        if not self.alive.all():
+            # re-home requests whose entry replica is dead to the first
+            # live member of their group (response duty must stay live)
+            dead = ~self.alive[entries]
+            if dead.any():
+                lm = self._member_np & self.alive[:, None]  # [R, G]
+                has = lm.any(axis=0)
+                flm = np.argmax(lm, axis=0).astype(np.int32)
+                fixable = dead & has[rows]
+                ei = idx[fixable]
+                store.entry[ei] = flm[rows[fixable]]
+                entries = store.entry[idx]
+                # groups with no live member at all: keep queued
+                keep = ~self.alive[entries]
+                if keep.any():
+                    sel = ~keep
+                    qk = q[keep]
+                    q, idx, rows, entries = (q[sel], idx[sel], rows[sel],
+                                             entries[sel])
+                else:
+                    qk = np.zeros(0, np.int64)
+            else:
+                qk = np.zeros(0, np.int64)
+        else:
+            qk = np.zeros(0, np.int64)
+        key = (entries.astype(np.int64) * self.G + rows).astype(np.intp)
+        first = self._first_occurrence(key, self._scr_pos, self._scr_gen)
+        # collision with slow-path placements at the same (entry, row):
+        # shift this tick's bulk entry up past the used p slots
+        p = np.zeros(len(q), np.int32)
+        if placed:
+            used = collections.Counter()
+            for row_, take in placed:
+                for _rid, e_, _p in take:
+                    used[(e_, row_)] += 1
+            for (e_, row_), cnt in used.items():
+                p[(entries == e_) & (rows == row_)] += cnt
+        fit = first & (p < self.P)
+        if fit.any():
+            fe, fp, fr = entries[fit], p[fit], rows[fit]
+            req[fe, fp, fr] = q[fit].astype(np.int32)
+            stp[fe, fp, fr] = store.stop[idx[fit]]
+            self._bulk_placed = (q[fit], fe, fp, fr)
+        rest = q[~fit]
+        self._bulk_leftover = (np.concatenate([rest, qk])
+                               if qk.size else rest)
+
     @_locked
-    def tick(self) -> HostOutbox:
+    def tick(self):
+        """One manager step.  Returns the tick's :class:`HostOutbox` (full
+        mode) / :class:`CompactHostOutbox` (compact mode); in pipelined mode
+        the return is the PREVIOUS tick's outbox (None on the first)."""
         inbox = self._build_inbox()
         placed = self._placed
+        bulk_placed = self._bulk_placed
         # dispatch first, journal second: the jitted step runs asynchronously
         # while the WAL appends+fsyncs this tick's record (SURVEY §2.2 item 3,
         # the BatchedLogger overlap, AbstractPaxosLogger.java:99-107).  Safe
         # because responses stay held until is_synced() (log-before-respond).
-        self.state, packed = paxos_tick_packed(self.state, inbox, -1)
+        if self._use_compact:
+            self.state, packed = paxos_tick_compact(
+                self.state, inbox, -1, self._exec_budget, self._lag_budget
+            )
+        else:
+            self.state, packed = paxos_tick_packed(self.state, inbox, -1)
         if self.wal is not None:
             self.wal.log_inbox(self.tick_num, inbox)
         self.tick_num += 1
         if self.cfg.paxos.pipeline_ticks:
-            # stage 3 of the overlap: execute the PREVIOUS tick's decision
-            # stream (host app work) while the device computes this one —
-            # ingest N+1 / device N / app-exec+WAL N-1 all concurrent
+            # deferred unpack: _pending_out holds the still-on-device packed
+            # buffer; the blocking device->host sync for tick N happens at
+            # tick N+1's completion, so the device computes N while the host
+            # builds N+1's inbox and the WAL fsyncs — ingest N+1 / device N
+            # / app-exec N-1 genuinely concurrent (SURVEY §2.2 item 3; the
+            # round-3 version unpacked eagerly, which blocked the host on
+            # the device before any overlap could happen)
             if self._pending_out is not None:
-                p_out, p_placed = self._pending_out
+                prev = self._pending_out
                 self._pending_out = None  # before completing: _complete_tick
                 # may reach drain_pipeline (pause_idle) — must not re-enter
-                self._complete_tick(p_out, p_placed)
-            out = unpack_outbox(packed, self.R, self.P, self.W, self.G)
-            self._pending_out = (out, placed)
+                out = self._complete_tick(*prev)
+            else:
+                out = None
+            self._pending_out = (packed, placed, bulk_placed)
             # a due checkpoint must cover on-host effects of every tick the
             # device state contains — drain the one-tick pipeline first
             if self.wal is not None and self.wal.checkpoint_due():
                 self.drain_pipeline()
         else:
-            out = unpack_outbox(packed, self.R, self.P, self.W, self.G)
-            self._complete_tick(out, placed)
+            out = self._complete_tick(packed, placed, bulk_placed)
         if self.wal is not None:
             self.wal.maybe_checkpoint()
         return out
 
-    def _complete_tick(self, out: HostOutbox, placed: list) -> None:
-        """Consume one tick's outbox: requeue rejected intake, execute the
-        ordered decision stream, release durable callbacks, periodic GC."""
-        self._process_outbox(out, placed)
+    def _complete_tick(self, packed, placed: list, bulk_placed=None):
+        """Consume one tick's outbox (unpacking = the device sync point):
+        requeue rejected intake, execute the ordered decision stream,
+        release durable callbacks, periodic GC."""
+        if self._use_compact:
+            out = unpack_compact(packed, self.R, self.G,
+                                 self._exec_budget, self._lag_budget)
+            self._process_compact(out, placed, bulk_placed)
+        else:
+            out = (packed if isinstance(packed, HostOutbox)
+                   else unpack_outbox(packed, self.R, self.P, self.W, self.G))
+            self._process_outbox(out, placed, bulk_placed)
         self._flush_callbacks()
         if self.tick_num % 64 == 0:
             self._sweep_outstanding()
@@ -581,15 +865,16 @@ class PaxosManager:
             and len(self.rows) > 0
         ):
             self.pause_idle()
+        return out
 
     @_locked
     def drain_pipeline(self) -> None:
         """Synchronously finish the pending pipelined outbox (no-op when
         nothing is pending or pipelining is off)."""
         if self._pending_out is not None:
-            p_out, p_placed = self._pending_out
+            prev = self._pending_out
             self._pending_out = None
-            self._complete_tick(p_out, p_placed)
+            self._complete_tick(*prev)
 
     def _flush_callbacks(self) -> None:
         """Release client responses only once the WAL covering their tick is
@@ -603,12 +888,22 @@ class PaxosManager:
         for cb, rid, resp in held:
             cb(rid, resp)
 
-    def _process_outbox(self, out: HostOutbox, placed=None) -> None:
+    def _process_outbox(self, out: HostOutbox, placed=None,
+                        bulk_placed=None) -> None:
         taken = out.intake_taken
         for row, take in (self._placed if placed is None else placed):
             for rid, entry, p in reversed(take):
                 if not taken[entry, p, row] and rid in self.outstanding:
                     self._queues[row].appendleft(rid)  # retry next tick
+        if bulk_placed is not None:
+            b_rids, b_e, b_p, b_r = bulk_placed
+            tk = taken[b_e, b_p, b_r]
+            rej = b_rids[~tk]
+            if rej.size:  # oldest first: rejected re-enter at the front
+                self._bulk_leftover = (
+                    np.concatenate([rej, self._bulk_leftover])
+                    if self._bulk_leftover.size else rej
+                )
         er, es, eb, ec = out.exec_req, out.exec_stop, out.exec_base, out.exec_count
         if ec.any():
             for row in np.where(ec.sum(axis=0) > 0)[0]:
@@ -629,6 +924,7 @@ class PaxosManager:
                      is_stop: bool) -> None:
         if is_stop and row not in self._stopped_rows:
             self._stopped_rows.add(row)
+            self._stopped_np[row] = True
             self._fail_queued(row)  # nothing after a stop can ever commit
         if rid == NO_REQUEST:
             self.stats["noops"] += 1
@@ -642,6 +938,11 @@ class PaxosManager:
             seen.popitem(last=False)
         rec = self.outstanding.get(rid)
         if rec is None:
+            if self.bulk is not None:
+                sidx = rid & self.bulk.mask
+                if self.bulk.valid[sidx] and self.bulk.rid[sidx] == rid:
+                    self._store_exec_one(r, row, rid, slot, sidx)
+                    return
             self.stats["orphan_execs"] += 1  # payload GC'd (laggard)
             return
         rec.slot = slot
@@ -661,13 +962,166 @@ class PaxosManager:
             del self.outstanding[rid]
             self._row_outstanding[row] -= 1
 
+    def _store_exec_one(self, r: int, row: int, rid: int, slot: int,
+                        sidx: int) -> None:
+        """Scalar execution of one bulk-store request (replay / full-outbox
+        fallback; the compact hot path uses the vectorized twin below)."""
+        s = self.bulk
+        bit = np.int64(1) << r
+        if s.exec_mask[sidx] & bit:
+            self.stats["dup_commits"] += 1
+            return
+        s.exec_mask[sidx] |= bit
+        if s.slot[sidx] < 0:
+            s.slot[sidx] = slot
+        name = self._row_name_np[row]
+        resp = self.apps[r].execute(name, s.payload[sidx], rid)
+        self.stats["executions"] += 1
+        if s.entry[sidx] == r and not s.responded[sidx]:
+            s.responded[sidx] = True
+            s.response[sidx] = resp
+        full = self._member_bits[row]
+        if s.responded[sidx] and (s.exec_mask[sidx] & full) == full:
+            s.valid[sidx] = False
+            s.payload[sidx] = None
+            s.response[sidx] = None
+            s.n_live -= 1
+            s.done += 1
+
+    def _process_compact(self, co: CompactHostOutbox, placed=None,
+                         bulk_placed=None) -> None:
+        """Vectorized twin of :meth:`_process_outbox` over the compacted
+        stream: every lifecycle step is an index-array operation; only
+        stops and non-store (dict) requests fall back to per-item code."""
+        taken = co.taken_bits
+        for row, take in (placed or []):
+            for rid, entry, p in reversed(take):
+                if (not (taken[entry, row] >> p) & 1
+                        and rid in self.outstanding):
+                    self._queues[row].appendleft(rid)
+        if bulk_placed is not None:
+            b_rids, b_e, b_p, b_r = bulk_placed
+            tk = (taken[b_e, b_r] >> b_p) & 1
+            rej = b_rids[tk == 0]
+            if rej.size:
+                self._bulk_leftover = (
+                    np.concatenate([rej, self._bulk_leftover])
+                    if self._bulk_leftover.size else rej
+                )
+        n = co.n_exec
+        store = self.bulk
+        if n:
+            rids = co.e_rid[:n].astype(np.int64)
+            reps = co.e_rep[:n]
+            rows = co.e_row[:n]
+            slots = co.e_slot[:n]
+            stops = co.e_stop[:n]
+            valid = rids != NO_REQUEST
+            # noop decisions (gap fills): stats parity with _execute_one
+            self.stats["noops"] += int((~valid & ~stops).sum())
+            self._last_active[rows] = self.tick_num
+            if store is not None:
+                idx, ok = store.lookup(rids)
+                ok &= valid
+            else:
+                idx, ok = None, np.zeros(n, bool)
+            # stops and dict-path/orphan rids: scalar path (rare at scale)
+            per_item = (valid & ~ok) | stops
+            vec = ok & ~stops
+            for i in np.nonzero(per_item)[0]:
+                row = int(rows[i])
+                name = self.rows.name(row)
+                if name is None:
+                    continue
+                self._execute_one(int(reps[i]), row, name, int(rids[i]),
+                                  int(slots[i]), bool(stops[i]))
+            touched = []
+            for r in range(self.R):
+                sel = vec & (reps == r)
+                if not sel.any():
+                    continue
+                idx_r = idx[sel]
+                # same rid committed twice in one tick (turnover re-propose):
+                # keep the first (lowest-slot) occurrence
+                fo = self._first_occurrence(idx_r, self._scr2_pos,
+                                            self._scr2_gen)
+                if not fo.all():
+                    self.stats["dup_commits"] += int((~fo).sum())
+                    idx_r = idx_r[fo]
+                rid_r = rids[sel][fo]
+                row_r = rows[sel][fo]
+                slot_r = slots[sel][fo]
+                fresh = store.mark_executed(idx_r, r)
+                if not fresh.all():
+                    self.stats["dup_commits"] += int((~fresh).sum())
+                    idx_r, rid_r, row_r, slot_r = (
+                        idx_r[fresh], rid_r[fresh], row_r[fresh],
+                        slot_r[fresh],
+                    )
+                if not len(idx_r):
+                    continue
+                ns = store.slot[idx_r] < 0
+                store.slot[idx_r[ns]] = slot_r[ns]
+                names = self._row_name_np[row_r]
+                resp = self.apps[r].execute_batch(
+                    names, store.payload[idx_r], rid_r
+                )
+                self.stats["executions"] += len(idx_r)
+                em = (store.entry[idx_r] == r) & ~store.responded[idx_r]
+                ri = idx_r[em]
+                if len(ri):
+                    store.responded[ri] = True
+                    ra = np.empty(len(resp), object)
+                    ra[:] = resp
+                    store.response[ri] = ra[em]
+                touched.append(idx_r)
+            if touched:
+                ti = np.concatenate(touched)
+                store.free_done(ti, self._member_bits[store.row[ti]])
+        self.stats["decisions"] += co.decided_total
+        self._lag_pending = (co.l_rep.copy(), co.l_row.copy())
+        if self.cfg.paxos.auto_laggard_sync and co.lag_n:
+            # self-heal: a replica >= W behind can never catch up by ring
+            # sync — its missed slots have rotated out of every decision
+            # ring.  The budget's fair ordering prevents self-inflicted
+            # lag, but crashes/recoveries still produce it.
+            for r_, row_ in zip(*self._lag_pending):
+                if not self.alive[r_]:
+                    continue
+                name = self.rows.name(int(row_))
+                if name:
+                    self.sync_laggard(int(r_), name)
+
     def _sweep_outstanding(self) -> None:
         """Drop responded records whose slot every live member has passed
         (laggards that far behind catch up by checkpoint transfer, not
         replay, so the payload is no longer needed)."""
-        if not self.outstanding:
+        if not self.outstanding and (self.bulk is None
+                                     or self.bulk.n_live == 0):
             return
         exec_slot = np.array(self.state.exec_slot)
+        if self.bulk is not None and self.bulk.n_live:
+            # vectorized twin for the store: free responded requests whose
+            # slot every LIVE member passed (a dead member's executed-bit
+            # will never arrive; its catch-up is a checkpoint transfer)
+            s = self.bulk
+            live_exec = np.where(self._member_np & self.alive[:, None],
+                                 exec_slot, np.iinfo(np.int32).max)
+            lmin = live_exec.min(axis=0)  # [G] min live-member watermark
+            any_live = (self._member_np & self.alive[:, None]).any(axis=0)
+            sel = np.nonzero(
+                s.valid & s.responded & (s.slot >= 0)
+                & any_live[s.row] & (s.slot < lmin[s.row])
+            )[0]
+            if len(sel):
+                s.valid[sel] = False
+                s.payload[sel] = None
+                s.response[sel] = None
+                s.n_live -= len(sel)
+                s.done += len(sel)
+                self.stats["swept"] += len(sel)
+        if not self.outstanding:
+            return
         member = self._member_np
         dead = []
         for rid, rec in self.outstanding.items():
@@ -687,23 +1141,31 @@ class PaxosManager:
         self.alive[r] = up
 
     @_locked
-    def sync_laggard(self, r: int, name: str) -> bool:
+    def sync_laggard(self, r: int, name: str, donor: Optional[int] = None) -> bool:
         """Checkpoint transfer for a replica lagging >= W on a group
         (StatePacket/handleCheckpoint analog,
         PaxosInstanceStateMachine.java:1852-1861): copy exec watermark from
         the most advanced live member and restore its app state.
+
+        The transfer mutates device state outside the journaled tick
+        stream, so it is journaled itself (OP_SYNC with the chosen donor);
+        replay passes ``donor`` explicitly because the liveness view that
+        picked it is not part of the journal.
         """
         row = self.rows.row(name)
         if row is None:
             return False
         exec_slot = np.array(self.state.exec_slot[:, row])
-        members = np.where(self._member_np[:, row])[0]
-        donors = [m for m in members if self.alive[m] and m != r]
-        if not donors:
-            return False
-        donor = max(donors, key=lambda m: exec_slot[m])
+        if donor is None:
+            members = np.where(self._member_np[:, row])[0]
+            donors = [m for m in members if self.alive[m] and m != r]
+            if not donors:
+                return False
+            donor = max(donors, key=lambda m: exec_slot[m])
         if exec_slot[donor] <= exec_slot[r]:
             return False
+        if self.wal is not None:
+            self.wal.log_sync(r, name, int(donor))
         ckpt = self.apps[donor].checkpoint(name)
         self.apps[r].restore(name, ckpt)
         self.state = self.state._replace(
@@ -713,16 +1175,46 @@ class PaxosManager:
             ),
         )
         self._seen.pop((r, row), None)
+        # a transfer skips slots [old, donor) on r without ever reporting
+        # them executed — settle the store's books or those requests stay
+        # live forever.  Entry-duty requests whose response was skipped are
+        # marked responded with no payload (client retries; at-least-once).
+        if self.bulk is not None:
+            s = self.bulk
+            lo, hi = int(exec_slot[r]), int(exec_slot[donor])
+            sel = np.nonzero(
+                s.valid & (s.row == row) & (s.slot >= lo) & (s.slot < hi)
+            )[0]
+            if len(sel):
+                s.exec_mask[sel] |= np.int64(1) << r
+                ent = (s.entry[sel] == r) & ~s.responded[sel]
+                s.responded[sel[ent]] = True
+                s.free_done(sel, self._member_bits[s.row[sel]])
         self.stats["checkpoint_transfers"] += 1
         return True
 
     @_locked
-    def auto_sync_laggards(self, out: TickOutbox) -> int:
-        """Scan the lag signal and run checkpoint transfers where ring sync
-        cannot catch up (lag >= W)."""
-        lag = np.array(out.lag)
+    def auto_sync_laggards(self, out=None) -> int:
+        """Run checkpoint transfers where ring sync cannot catch up
+        (lag >= W).  Accepts a full outbox; with None or a compacted one,
+        uses the device-compacted laggard list of the last completed tick."""
+        if out is None or isinstance(out, CompactHostOutbox):
+            if out is None and not self._use_compact:
+                # _lag_pending is only fed by the compact path; silently
+                # iterating its (empty) initial value would strand laggards
+                raise ValueError(
+                    "auto_sync_laggards() needs the tick's outbox in "
+                    "full-outbox mode"
+                )
+            src = out if out is not None else None
+            l_rep = src.l_rep if src is not None else self._lag_pending[0]
+            l_row = src.l_row if src is not None else self._lag_pending[1]
+            pairs = zip(l_rep, l_row)
+        else:
+            lag = np.array(out.lag)
+            pairs = zip(*np.where(lag >= self.W))
         n = 0
-        for r, row in zip(*np.where(lag >= self.W)):
+        for r, row in pairs:
             if not self.alive[r]:
                 continue
             name = self.rows.name(int(row))
@@ -738,6 +1230,8 @@ class PaxosManager:
     @_locked
     def pending_count(self) -> int:
         n = sum(len(q) for q in self._queues.values()) + len(self._staged)
+        n += int(self._bulk_leftover.size)
+        n += sum(len(c) for c in self._bulk_chunks)
         if self._pending_out is not None:
             n += 1  # a pipelined outbox still needs a tick to complete
         return n

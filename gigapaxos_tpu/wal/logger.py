@@ -41,6 +41,8 @@ OP_REMOVE = 2
 OP_TICK = 3
 OP_PAUSE = 4
 OP_UNPAUSE = 5
+OP_SYNC = 6  # checkpoint transfer (laggard repair) — state change outside
+             # the tick stream, so replay must re-apply it in sequence
 
 
 def _new_journal(path: str, native_ok: bool):
@@ -107,6 +109,9 @@ class PaxosLogger:
     def log_unpause(self, name: str) -> None:
         self.journal.append(pickle.dumps((OP_UNPAUSE, name)))
 
+    def log_sync(self, r: int, name: str, donor: int) -> None:
+        self.journal.append(pickle.dumps((OP_SYNC, r, name, donor)))
+
     def log_inbox(self, tick_num: int, inbox) -> None:
         """Called by the manager after `_build_inbox`, before running the
         tick: record exactly what was placed, with payloads for replay."""
@@ -121,9 +126,24 @@ class PaxosLogger:
                 entries.append((rid, entry, p, rec.payload, rec.stop))
             if entries:
                 placed_with_payloads.append((row, entries))
+        bulk = None
+        bp = getattr(m, "_bulk_placed", None)
+        if bp is not None:
+            rids, be, bpp, br = bp
+            idx = m.bulk.idx_of(rids)
+            payloads = m.bulk.payload[idx]
+            bulk = (
+                rids.astype(np.int64).tobytes(),
+                be.astype(np.int32).tobytes(),
+                bpp.astype(np.int32).tobytes(),
+                br.astype(np.int32).tobytes(),
+                m.bulk.stop[idx].tobytes(),
+                list(payloads),
+            )
         alive = np.asarray(inbox.alive).tobytes()
         self.journal.append(
-            pickle.dumps((OP_TICK, tick_num, placed_with_payloads, alive))
+            pickle.dumps((OP_TICK, tick_num, placed_with_payloads, alive,
+                          bulk))
         )
         self._ticks_since_sync += 1
         if self._ticks_since_sync >= self.sync_every:
@@ -177,6 +197,17 @@ class PaxosLogger:
             # journal holding their OP_CREATE is GC'd.  peek() keeps cold
             # entries on disk instead of rewriting the whole cold tier.
             "paused": self._paused_snapshot(m),
+            # bulk-path state: live columnar store entries + queued rids
+            "bulk": (m.bulk.snapshot()
+                     if getattr(m, "bulk", None) is not None else None),
+            "bulk_queue": (
+                np.concatenate(
+                    ([m._bulk_leftover] if m._bulk_leftover.size else [])
+                    + list(m._bulk_chunks)
+                ) if getattr(m, "bulk", None) is not None
+                and (m._bulk_leftover.size or m._bulk_chunks)
+                else None
+            ),
             "apps": [
                 {
                     name: m.apps[i].checkpoint(name)
@@ -235,7 +266,7 @@ class PaxosLogger:
 
 # ------------------------------------------------------------------ recovery
 def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
-                    build_inbox, tick_fn):
+                    build_inbox, tick_fn, bulk_replay=None):
     """Shared journal-replay loop (passes 2–3 of recovery) for any manager.
 
     The protocol-specific parts are injected: ``make_record`` builds the
@@ -267,11 +298,18 @@ def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
                 m._do_pause([n for n in rec[1] if n in m.rows])
             elif op == OP_UNPAUSE:
                 m._unpause(rec[1])
+            elif op == OP_SYNC:
+                _, r, name, donor = rec
+                m.sync_laggard(r, name, donor=donor)
             elif op == OP_TICK:
-                _, tick_num, placed, alive_b = rec
+                _, tick_num, placed, alive_b = rec[:4]
+                bulk_rec = rec[4] if len(rec) > 4 else None
                 if tick_num < m.tick_num:
                     continue  # already inside the snapshot
                 bufs = new_buffers(m)
+                bulk_placed = None
+                if bulk_rec is not None and bulk_replay is not None:
+                    bulk_placed = bulk_replay(m, bufs, bulk_rec)
                 m._placed = []
                 for row, entries in placed:
                     take = []
@@ -295,7 +333,10 @@ def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
                         )
                 alive = np.frombuffer(alive_b, dtype=bool)
                 m.state, out = tick_fn(m.state, build_inbox(bufs, alive))
-                m._process_outbox(out)
+                if bulk_placed is not None:
+                    m._process_outbox(out, None, bulk_placed)
+                else:
+                    m._process_outbox(out)
                 m.tick_num = tick_num + 1
 
 
@@ -332,6 +373,21 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
         m._next_rid = meta["next_rid"]
         m.rows.restore(meta["rows"], meta.get("free_rows"))
         m._stopped_rows = set(meta["stopped_rows"])
+        # rebuild the vectorized-path host mirrors from the restored config
+        m._stopped_np[:] = False
+        m._stopped_np[list(m._stopped_rows)] = True
+        m._member_bits = (
+            (np.int64(1) << np.arange(m.R, dtype=np.int64))[:, None]
+            * m._member_np
+        ).sum(axis=0)
+        m._row_name_np[:] = None
+        for name, row in m.rows.items():
+            m._row_name_np[row] = name
+        m._member_ord = None
+        if meta.get("bulk") is not None:
+            m._ensure_bulk().restore(meta["bulk"])
+        if meta.get("bulk_queue") is not None:
+            m._bulk_leftover = np.asarray(meta["bulk_queue"], np.int64)
         for k, items in meta["seen"].items():
             od = collections.OrderedDict(items)
             m._seen[k] = od
@@ -374,11 +430,36 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
                          jnp.asarray(alive))
 
     def tick_host(state, inbox):
-        state, packed = paxos_tick_packed(state, inbox, -1)
+        # replay must evolve state EXACTLY as the live run did, so the
+        # exec budget (if the live run used the compact path) applies here
+        # too even though replay consumes the full outbox
+        budget = m._exec_budget if m._use_compact else 0
+        state, packed = paxos_tick_packed(state, inbox, -1, budget)
         return state, unpack_outbox(packed, m.R, m.P, m.W, m.G)
 
+    def bulk_replay(m, bufs, bulk_rec):
+        rids_b, be_b, bp_b, br_b, stop_b, payloads = bulk_rec
+        rids = np.frombuffer(rids_b, np.int64)
+        be = np.frombuffer(be_b, np.int32)
+        bp = np.frombuffer(bp_b, np.int32)
+        br = np.frombuffer(br_b, np.int32)
+        stops = np.frombuffer(stop_b, bool)
+        store = m._ensure_bulk()
+        m._next_rid = max(m._next_rid, int(rids.max()) + 1) if len(rids) \
+            else m._next_rid
+        store.admit_at(rids, br, be, stops, payloads)
+        # a snapshot may hold queued copies of rids whose placement is
+        # journaled after it; drop them or they place twice
+        if m._bulk_leftover.size:
+            m._bulk_leftover = m._bulk_leftover[
+                ~np.isin(m._bulk_leftover, rids)
+            ]
+        bufs[0][be, bp, br] = rids.astype(np.int32)
+        bufs[1][be, bp, br] = stops
+        return (rids, be, bp, br)
+
     replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
-                    build_inbox, tick_host)
+                    build_inbox, tick_host, bulk_replay=bulk_replay)
     # reattach logging
     logger.attach(m)
     m.wal = logger

@@ -1,0 +1,166 @@
+"""End-to-end framework throughput: decisions/sec through the REAL
+PaxosManager stack (inbox build -> device tick -> WAL -> compacted outbox ->
+vectorized execution -> completion accounting) at 100k-1M groups.
+
+This is the measurement the kernel-only ``bench.py`` deliberately excludes:
+every decision here flows through request admission (``propose_bulk``),
+journaling, the compacted device->host transfer, app execution
+(``DenseCounterApp``), and client-visible completion — the full hot-path
+inventory of SURVEY §3.2.  Methodology mirrors the reference capacity probe
+(``gigapaxos/testing/TESTPaxosConfig.java:190-229``): sustained open-loop
+load with admission control, steady-state window measured.
+
+Usage:  python benchmarks/stack_bench.py [--groups N] [--ticks T] [--wal]
+        [--platform cpu] [--profile]
+Prints one JSON line per run; commit the output into results_r4.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import struct
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--groups", type=int, default=1 << 17)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--window", type=int, default=8)
+    ap.add_argument("--ticks", type=int, default=30)
+    ap.add_argument("--warmup", type=int, default=8)
+    ap.add_argument("--wal", action="store_true", help="journal every tick")
+    ap.add_argument("--wal-dir", default="/tmp/gptpu_stack_wal")
+    ap.add_argument("--sync-every", type=int, default=8)
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu)")
+    ap.add_argument("--profile", action="store_true",
+                    help="report per-stage host timings")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import numpy as np
+
+    from gigapaxos_tpu.config import GigapaxosTpuConfig
+    from gigapaxos_tpu.models.dense_apps import DenseCounterApp
+    from gigapaxos_tpu.paxos.manager import PaxosManager
+
+    G, R = args.groups, args.replicas
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = G
+    cfg.paxos.window = args.window
+    cfg.paxos.proposals_per_tick = 2
+    cfg.paxos.compact_outbox = True
+    cfg.paxos.pipeline_ticks = True
+    cfg.paxos.exec_budget = R * G + 4096  # steady-state demand + headroom
+    cfg.paxos.bulk_capacity = 8 * G
+    cfg.paxos.sync_every_ticks = args.sync_every
+    cfg.paxos.deactivation_ticks = 0  # no pause scans mid-measurement
+
+    apps = [DenseCounterApp(G) for _ in range(R)]
+    wal = None
+    if args.wal:
+        import shutil
+
+        from gigapaxos_tpu.wal.logger import PaxosLogger
+
+        shutil.rmtree(args.wal_dir, ignore_errors=True)
+        wal = PaxosLogger(args.wal_dir, sync_every_ticks=args.sync_every,
+                          checkpoint_every_ticks=1 << 30)
+    m = PaxosManager(cfg, R, apps, wal=wal)
+    for a in apps:
+        a.row_of = m.rows.row
+
+    # bulk-create all groups (batched createPaxosInstance; the per-name
+    # admin path is control-plane, not the measurement)
+    t0 = time.perf_counter()
+    from gigapaxos_tpu.paxos import state as st
+
+    rows = np.arange(G, dtype=np.int32)
+    m.state = st.create_groups(m.state, rows, np.ones((G, R), bool))
+    for i in range(G):
+        m.rows._name_to_row[f"g{i}"] = i
+        m.rows._row_to_name[i] = f"g{i}"
+    m.rows._free = []
+    m._member_np[:, :] = True
+    m._n_members_np[:] = R
+    m._member_bits[:] = (1 << R) - 1
+    m._row_name_np[:] = [f"g{i}" for i in range(G)]
+    m._member_ord = None
+    create_s = time.perf_counter() - t0
+
+    # pre-generated request waves (TESTPaxosClient pre-generates too); the
+    # payloads are distinct 8-byte deltas so nothing is amortized unfairly
+    n_waves = 4
+    waves = []
+    for w in range(n_waves):
+        pa = np.empty(G, object)
+        pa[:] = [struct.pack("<q", (w * G + i) % 97) for i in range(G)]
+        waves.append(pa)
+
+    stages = {"propose": 0.0, "tick": 0.0}
+
+    def one_tick(i):
+        w = waves[i % n_waves]
+        t = time.perf_counter()
+        # admission control: only offer what the store window can take
+        if m.bulk_stats()["queued"] < G:
+            rids = m.propose_bulk(rows, list(w))
+        t2 = time.perf_counter()
+        m.tick()
+        t3 = time.perf_counter()
+        stages["propose"] += t2 - t
+        stages["tick"] += t3 - t2
+
+    for i in range(args.warmup):
+        one_tick(i)
+    m.drain_pipeline()
+    base_dec = m.stats["decisions"]
+    base_done = m.bulk_stats()["done"]
+    for k in stages:
+        stages[k] = 0.0
+    t0 = time.perf_counter()
+    for i in range(args.ticks):
+        one_tick(args.warmup + i)
+    m.drain_pipeline()
+    dt = time.perf_counter() - t0
+    decisions = m.stats["decisions"] - base_dec
+    done = m.bulk_stats()["done"] - base_done
+
+    backend = jax.devices()[0].platform
+    result = {
+        "metric": f"stack_decisions_per_sec_{G}_groups_{R}_replicas"
+                  + ("_wal" if args.wal else "")
+                  + (f"_{backend}" if backend not in ("tpu", "axon") else ""),
+        "value": round(decisions / dt, 1),
+        "unit": "decisions/s",
+        "vs_baseline": round(decisions / dt / 100_000.0, 2),
+        "detail": {
+            "ticks_per_s": round(args.ticks / dt, 2),
+            "completions_per_s": round(done / dt, 1),
+            "executions_per_s": round(decisions * R / dt, 1),
+            "groups": G,
+            "create_s": round(create_s, 2),
+            "wal": bool(args.wal),
+        },
+    }
+    if args.profile:
+        result["detail"]["stage_s_per_tick"] = {
+            k: round(v / args.ticks, 4) for k, v in stages.items()
+        }
+    print(json.dumps(result))
+    if wal is not None:
+        wal.close()
+
+
+if __name__ == "__main__":
+    main()

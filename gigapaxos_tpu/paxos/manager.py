@@ -1062,18 +1062,22 @@ class PaxosManager:
                     continue
                 ns = store.slot[idx_r] < 0
                 store.slot[idx_r[ns]] = slot_r[ns]
-                names = self._row_name_np[row_r]
-                resp = self.apps[r].execute_batch(
-                    names, store.payload[idx_r], rid_r
-                )
+                erb = getattr(self.apps[r], "execute_rows_batch", None)
+                if erb is not None:
+                    resp = erb(row_r, store.payload[idx_r], rid_r)
+                else:
+                    resp = self.apps[r].execute_batch(
+                        self._row_name_np[row_r], store.payload[idx_r], rid_r
+                    )
                 self.stats["executions"] += len(idx_r)
                 em = (store.entry[idx_r] == r) & ~store.responded[idx_r]
                 ri = idx_r[em]
                 if len(ri):
                     store.responded[ri] = True
-                    ra = np.empty(len(resp), object)
-                    ra[:] = resp
-                    store.response[ri] = ra[em]
+                    if resp is not None:
+                        ra = np.empty(len(resp), object)
+                        ra[:] = resp
+                        store.response[ri] = ra[em]
                 touched.append(idx_r)
             if touched:
                 ti = np.concatenate(touched)

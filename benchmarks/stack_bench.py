@@ -35,6 +35,9 @@ def main() -> None:
     ap.add_argument("--ticks", type=int, default=30)
     ap.add_argument("--warmup", type=int, default=8)
     ap.add_argument("--wal", action="store_true", help="journal every tick")
+    ap.add_argument("--device", action="store_true",
+                    help="device-app mode: decisions execute ON DEVICE "
+                         "(propose_bulk_kv; no host app work at all)")
     ap.add_argument("--wal-dir", default="/tmp/gptpu_stack_wal")
     ap.add_argument("--sync-every", type=int, default=8)
     ap.add_argument("--platform", default=None,
@@ -65,8 +68,11 @@ def main() -> None:
     cfg.paxos.bulk_capacity = 8 * G
     cfg.paxos.sync_every_ticks = args.sync_every
     cfg.paxos.deactivation_ticks = 0  # no pause scans mid-measurement
+    if args.device:
+        cfg.paxos.device_app = True
 
-    apps = [DenseCounterApp(G) for _ in range(R)]
+    apps = ([None] * R if args.device
+            else [DenseCounterApp(G) for _ in range(R)])
     wal = None
     if args.wal:
         import shutil
@@ -77,8 +83,9 @@ def main() -> None:
         wal = PaxosLogger(args.wal_dir, sync_every_ticks=args.sync_every,
                           checkpoint_every_ticks=1 << 30)
     m = PaxosManager(cfg, R, apps, wal=wal)
-    for a in apps:
-        a.row_of = m.rows.row
+    if not args.device:
+        for a in apps:
+            a.row_of = m.rows.row
 
     # bulk-create all groups (batched createPaxosInstance; the per-name
     # admin path is control-plane, not the measurement)
@@ -101,20 +108,33 @@ def main() -> None:
     # pre-generated request waves (TESTPaxosClient pre-generates too); the
     # payloads are distinct 8-byte deltas so nothing is amortized unfairly
     n_waves = 4
-    waves = []
-    for w in range(n_waves):
-        pa = np.empty(G, object)
-        pa[:] = [struct.pack("<q", (w * G + i) % 97) for i in range(G)]
-        waves.append(pa)
+    if args.device:
+        from gigapaxos_tpu.models.device_kv import OP_PUT
+
+        kv_waves = [
+            (np.full(G, OP_PUT, np.int32),
+             (np.arange(G) % (cfg.paxos.kv_slots - 1) + 1).astype(np.int32),
+             np.arange(w, w + G, dtype=np.int32))
+            for w in range(n_waves)
+        ]
+    else:
+        waves = []
+        for w in range(n_waves):
+            pa = np.empty(G, object)
+            pa[:] = [struct.pack("<q", (w * G + i) % 97) for i in range(G)]
+            waves.append(pa)
 
     stages = {"propose": 0.0, "tick": 0.0}
 
     def one_tick(i):
-        w = waves[i % n_waves]
         t = time.perf_counter()
         # admission control: only offer what the store window can take
         if m.bulk_stats()["queued"] < G:
-            rids = m.propose_bulk(rows, list(w))
+            if args.device:
+                ops, keys, vals = kv_waves[i % n_waves]
+                m.propose_bulk_kv(rows, ops, keys, vals)
+            else:
+                m.propose_bulk(rows, list(waves[i % n_waves]))
         t2 = time.perf_counter()
         m.tick()
         t3 = time.perf_counter()
@@ -139,6 +159,7 @@ def main() -> None:
     backend = jax.devices()[0].platform
     result = {
         "metric": f"stack_decisions_per_sec_{G}_groups_{R}_replicas"
+                  + ("_device_kv" if args.device else "")
                   + ("_wal" if args.wal else "")
                   + (f"_{backend}" if backend not in ("tpu", "axon") else ""),
         "value": round(decisions / dt, 1),

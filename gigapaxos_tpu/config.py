@@ -78,6 +78,26 @@ class PaxosTuning:
     # rounded up to a power of two).  Bounds requests in flight on the
     # propose_bulk path (MAX_OUTSTANDING_REQUESTS analog).
     bulk_capacity: int = 0
+    # Device-resident application (models/device_kv.py): the manager owns
+    # a DeviceKVState, request descriptors upload inside the fused tick,
+    # and decisions execute ON DEVICE — the decision stream never crosses
+    # to the host except as the compacted bookkeeping/response arrays.
+    # Requires compact_outbox.
+    device_app: bool = False
+    # KV slots per group (power of two) and descriptor-table size
+    # (0 = auto: 4 * max_groups rounded up to a power of two, min 65536).
+    kv_slots: int = 8
+    kv_table: int = 0
+    # Max descriptor uploads per tick (0 = auto: 2 * max_groups).  Staged
+    # admissions beyond it defer (their placement waits with them).
+    kv_reg_budget: int = 0
+    # Tick coalescing: minimum spacing between driver ticks while busy.
+    # Each tick has a fixed host cost (admission, placement, compaction
+    # unpack); spacing ticks lets requests accumulate so that cost
+    # amortizes — the RequestBatcher's adaptive-sleep idea
+    # (RequestBatcher.java:25-60) as a pacing floor.  Adds up to this much
+    # commit latency; 0 = tick as fast as possible.
+    min_tick_interval_s: float = 0.0
 
     def __post_init__(self) -> None:
         if self.window < 2 or (self.window & (self.window - 1)):

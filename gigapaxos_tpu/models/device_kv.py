@@ -11,7 +11,8 @@ throughput orders of magnitude below the raw kernel.
 stream NEVER leaves the device:
 
 * app state — a direct-mapped KV cache per (replica, group):
-  ``key[R, G, S]`` / ``val[R, G, S]`` int32 (0 = empty slot; key k lives at
+  ``key[R, G, S]`` / ``val[R, G, S]`` int32 (0 = empty slot — key 0 is
+  RESERVED as that sentinel, clients use keys >= 1; key k lives at
   slot ``k & (S-1)``, last-writer-wins on collision, deterministic on every
   replica by construction);
 * request descriptors — clients register ``rid -> (op, key, val)`` in a
@@ -139,7 +140,10 @@ def kv_apply(kv: DeviceKVState, exec_req: jnp.ndarray,
         resp = jnp.where(
             op_j == OP_PUT, v_j, jnp.where(present, cur_val, 0)
         )
-        wr = (op_j == OP_PUT) | (op_j == OP_DEL)
+        # DEL writes only when the key is actually resident: deleting an
+        # absent key must not erase a colliding occupant (and must match
+        # the scalar fallback's semantics exactly)
+        wr = (op_j == OP_PUT) | ((op_j == OP_DEL) & present)
         wslot = jnp.where(wr, slot_j, S)  # S -> drop
         nk = jnp.where(op_j == OP_DEL, 0, k_j)
         nv = jnp.where(op_j == OP_DEL, 0, v_j)
@@ -167,33 +171,134 @@ fused_step_jit = jax.jit(fused_step, donate_argnums=(0, 1),
                          static_argnums=(3,))
 
 
+def _fused_compact_impl(state, kv: DeviceKVState, inbox: TickInbox,
+                        reg_rids, reg_ops, reg_keys, reg_vals,
+                        own_row: int, exec_budget: int, lag_budget: int):
+    """Descriptor upload + consensus tick + KV apply + outbox compaction in
+    ONE device program: the deployment-path twin of :func:`fused_step`.
+
+    The compacted buffer grows one extra array vs the consensus-only
+    compaction: per-execution KV responses (e_resp), scattered with the
+    same prefix-sum ranks, so entry replicas answer clients without any
+    O(R*W*G) transfer.  reg_*: this tick's new request descriptors
+    ([K] i32; rid 0 = empty slot — a fixed-size upload keeps the jit
+    signature static).
+    """
+    from ..ops.tick import _compact_outbox_impl, paxos_tick_impl
+
+    kv = register_requests(kv, reg_rids, reg_ops, reg_keys, reg_vals)
+    new_state, out = paxos_tick_impl(state, inbox, own_row, exec_budget)
+    kv2, responses, miss = kv_apply(kv, out.exec_req, out.exec_count)
+    packed = _compact_outbox_impl(out, exec_budget, lag_budget)
+    # responses ride a second scatter with the same ranks as the exec stream
+    R, W, G = out.exec_req.shape
+    ji = jnp.arange(W, dtype=I32)[None, :, None]
+    mask = ji < out.exec_count[:, None, :]
+    mf = mask.reshape(-1)
+    mi = mf.astype(I32)
+    rank = jnp.cumsum(mi) - mi
+    idx = jnp.where(mf, rank, exec_budget)
+    e_resp = jnp.zeros((exec_budget,), I32).at[idx].set(
+        responses.reshape(-1), mode="drop"
+    )
+    e_miss = jnp.zeros((exec_budget,), I32).at[idx].set(
+        miss.astype(I32).reshape(-1), mode="drop"
+    )
+    return new_state, kv2, jnp.concatenate([packed, e_resp, e_miss])
+
+
+fused_compact = jax.jit(_fused_compact_impl, donate_argnums=(0, 1),
+                        static_argnums=(7, 8, 9))
+
+
+#: descriptor wire format for device-app request payloads: op, key, value
+DESC = "<iii"
+DESC_LEN = 12
+
+
+def pack_desc(op: int, key: int, val: int) -> bytes:
+    import struct
+
+    return struct.pack(DESC, op, key, val)
+
+
 class DeviceKVApp:
-    """Replicable-shaped wrapper so the control plane can checkpoint /
-    restore device KV groups (row-granular pulls; the hot path never calls
-    ``execute`` — that is the whole point).
+    """Replicable face of the MANAGER-OWNED device KV state.
+
+    One source of truth: ``owner.kv`` is the live DeviceKVState the fused
+    tick evolves (``PaxosManager.kv`` in device-app mode); this wrapper
+    gives the control plane (checkpoint transfer, epoch final state,
+    recovery seeding) row-granular views of it.  The hot path never calls
+    ``execute`` — decisions execute on-device inside ``fused_compact``;
+    the scalar ``execute`` below applies one descriptor through the same
+    semantics for the rare host fallbacks (control-plane proposes, WAL
+    scalar replay).
 
     ``row_of(name)`` maps service names to group rows (wire it to the
     manager's RowAllocator).
     """
 
-    def __init__(self, kv: DeviceKVState, replica: int,
-                 row_of=None):
-        self.kv = kv
+    def __init__(self, owner, replica: int, row_of=None):
+        self.owner = owner  # any object with a mutable .kv attribute
         self.replica = replica
         self.row_of = row_of or (lambda name: None)
 
+    def _lock(self):
+        """Every access to owner.kv must exclude the fused tick: the tick
+        DONATES the kv buffers, so a concurrent read races buffer deletion.
+        The owner's lock is reentrant (tick-held paths still work)."""
+        import contextlib
+
+        lk = getattr(self.owner, "lock", None)
+        return lk if lk is not None else contextlib.nullcontext()
+
+    @property
+    def kv(self) -> DeviceKVState:
+        return self.owner.kv
+
+    @kv.setter
+    def kv(self, v: DeviceKVState) -> None:
+        self.owner.kv = v
+
     def execute(self, name: str, request: bytes, request_id: int) -> bytes:
-        raise NotImplementedError(
-            "device app decisions execute on-device via fused_step; the "
-            "host slow path is only for descriptor misses"
-        )
+        """Scalar fallback: apply one 12-byte descriptor to this replica's
+        row (same semantics as the vectorized kv_apply plane step)."""
+        import struct
+
+        row = self.row_of(name)
+        if row is None or len(request) != DESC_LEN:
+            return b""
+        op, k, v = struct.unpack(DESC, request)
+        with self._lock():
+            kv = self.kv
+            slot = k & (kv.slots - 1)
+            cur_k = int(kv.key[self.replica, row, slot])
+            cur_v = int(kv.val[self.replica, row, slot])
+            present = cur_k == k
+            if op == OP_PUT:
+                self.kv = kv._replace(
+                    key=kv.key.at[self.replica, row, slot].set(k),
+                    val=kv.val.at[self.replica, row, slot].set(v),
+                )
+                resp = v
+            elif op == OP_DEL:
+                if present:
+                    self.kv = kv._replace(
+                        key=kv.key.at[self.replica, row, slot].set(0),
+                        val=kv.val.at[self.replica, row, slot].set(0),
+                    )
+                resp = cur_v if present else 0
+            else:  # GET / NONE
+                resp = cur_v if present else 0
+        return struct.pack("<i", resp)
 
     def checkpoint(self, name: str) -> bytes:
         row = self.row_of(name)
         if row is None:
             return b""
-        keys = np.asarray(self.kv.key[self.replica, row])
-        vals = np.asarray(self.kv.val[self.replica, row])
+        with self._lock():
+            keys = np.asarray(self.kv.key[self.replica, row])
+            vals = np.asarray(self.kv.val[self.replica, row])
         live = keys != 0
         return json.dumps({
             "k": keys[live].tolist(), "v": vals[live].tolist(),
@@ -203,15 +308,16 @@ class DeviceKVApp:
         row = self.row_of(name)
         if row is None:
             return
-        S = self.kv.slots
-        keys = np.zeros(S, np.int32)
-        vals = np.zeros(S, np.int32)
-        if state:
-            d = json.loads(state.decode())
-            for k, v in zip(d["k"], d["v"]):
-                keys[k & (S - 1)] = k
-                vals[k & (S - 1)] = v
-        self.kv = self.kv._replace(
-            key=self.kv.key.at[self.replica, row].set(jnp.asarray(keys)),
-            val=self.kv.val.at[self.replica, row].set(jnp.asarray(vals)),
-        )
+        with self._lock():
+            S = self.kv.slots
+            keys = np.zeros(S, np.int32)
+            vals = np.zeros(S, np.int32)
+            if state:
+                d = json.loads(state.decode())
+                for k, v in zip(d["k"], d["v"]):
+                    keys[k & (S - 1)] = k
+                    vals[k & (S - 1)] = v
+            self.kv = self.kv._replace(
+                key=self.kv.key.at[self.replica, row].set(jnp.asarray(keys)),
+                val=self.kv.val.at[self.replica, row].set(jnp.asarray(vals)),
+            )

@@ -1,0 +1,133 @@
+"""Binary batched-request frames: the client edge's SoA wire format.
+
+The JSON batch path (APP_REQUEST_BATCH) already amortizes frames and
+syscalls; at tens of thousands of requests/sec the per-item base64+dict
+encode/decode becomes the cap.  These frames are the binary payload path of
+the reference's batched ``RequestPacket`` (paxospackets/RequestPacket.java:
+189-233, which likewise ships a packed ``batched[]`` body): columnar arrays
+(name-table indices, rids, payload offsets) that both ends encode/decode
+with numpy, leaving only O(unique names) string work per frame.
+
+Frame kinds ride the transport's raw-bytes channel behind 4-byte magics,
+chained with the other bytes consumers (mode-B frames, bulk transfers).
+
+Request frame  (client -> active):
+  b"GBR1" | bid u64 | host u8+bytes | port u16 | client_id u8+bytes
+  | n_names u16 | {u16 len + bytes} * n_names
+  | n u32 | name_idx u16*n | rid u64*n | plen u32*n | payload blob
+Response frame (active -> client):
+  b"GBS1" | bid u64 | n u32 | rid u64*n | status u8*n | rlen u32*n | blob
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Tuple
+
+import numpy as np
+
+REQ_MAGIC = b"GBR1"
+RESP_MAGIC = b"GBS1"
+
+
+def encode_request(bid: int, host: str, port: int, client_id: str,
+                   items: List[Tuple[str, int, bytes]]) -> bytes:
+    """items: (name, rid, payload)."""
+    names: dict = {}
+    for name, _rid, _p in items:
+        if name not in names:
+            names[name] = len(names)
+    n = len(items)
+    idx = np.fromiter((names[it[0]] for it in items), np.uint16, n)
+    rids = np.fromiter((it[1] for it in items), np.uint64, n)
+    plens = np.fromiter((len(it[2]) for it in items), np.uint32, n)
+    hb = host.encode()
+    cb = client_id.encode()
+    head = [REQ_MAGIC, struct.pack("<QB", bid, len(hb)), hb,
+            struct.pack("<HB", port, len(cb)), cb,
+            struct.pack("<H", len(names))]
+    for name in names:
+        nb = name.encode()
+        head.append(struct.pack("<H", len(nb)))
+        head.append(nb)
+    head.append(struct.pack("<I", n))
+    return b"".join(head) + idx.tobytes() + rids.tobytes() + plens.tobytes() \
+        + b"".join(it[2] for it in items)
+
+
+def decode_request(buf: bytes):
+    """Returns (bid, (host, port), client_id, names, name_idx, rids,
+    payloads list of bytes)."""
+    assert buf[:4] == REQ_MAGIC
+    o = 4
+    bid, hlen = struct.unpack_from("<QB", buf, o)
+    o += 9
+    host = buf[o:o + hlen].decode()
+    o += hlen
+    port, clen = struct.unpack_from("<HB", buf, o)
+    o += 3
+    client_id = buf[o:o + clen].decode()
+    o += clen
+    (n_names,) = struct.unpack_from("<H", buf, o)
+    o += 2
+    names = []
+    for _ in range(n_names):
+        (ln,) = struct.unpack_from("<H", buf, o)
+        o += 2
+        names.append(buf[o:o + ln].decode())
+        o += ln
+    (n,) = struct.unpack_from("<I", buf, o)
+    o += 4
+    idx = np.frombuffer(buf, np.uint16, n, o)
+    o += 2 * n
+    rids = np.frombuffer(buf, np.uint64, n, o)
+    o += 8 * n
+    plens = np.frombuffer(buf, np.uint32, n, o)
+    o += 4 * n
+    offs = np.zeros(n + 1, np.int64)
+    np.cumsum(plens, out=offs[1:])
+    mv = memoryview(buf)
+    payloads = [bytes(mv[o + offs[i]:o + offs[i + 1]]) for i in range(n)]
+    return bid, (host, port), client_id, names, idx, rids, payloads
+
+
+def encode_response(bid: int, rids, statuses, bodies: List[bytes]) -> bytes:
+    n = len(bodies)
+    rl = np.fromiter((len(b) for b in bodies), np.uint32, n)
+    return (RESP_MAGIC + struct.pack("<QI", bid, n)
+            + np.asarray(rids, np.uint64).tobytes()
+            + np.asarray(statuses, np.uint8).tobytes()
+            + rl.tobytes() + b"".join(bodies))
+
+
+def decode_response(buf: bytes):
+    """Returns (bid, rids u64[n], statuses u8[n], bodies list of bytes)."""
+    assert buf[:4] == RESP_MAGIC
+    bid, n = struct.unpack_from("<QI", buf, 4)
+    o = 16
+    rids = np.frombuffer(buf, np.uint64, n, o)
+    o += 8 * n
+    statuses = np.frombuffer(buf, np.uint8, n, o)
+    o += n
+    rlens = np.frombuffer(buf, np.uint32, n, o)
+    o += 4 * n
+    offs = np.zeros(n + 1, np.int64)
+    np.cumsum(rlens, out=offs[1:])
+    mv = memoryview(buf)
+    bodies = [bytes(mv[o + offs[i]:o + offs[i + 1]]) for i in range(n)]
+    return bid, rids, statuses, bodies
+
+
+def chain_bytes_handler(demux, magic: bytes, handler) -> None:
+    """Install ``handler(sender, payload)`` for frames starting with
+    ``magic``, falling through to the previously installed consumer (the
+    mode-B frame chain idiom)."""
+    prev = demux.bytes_handler
+
+    def on_bytes(sender: str, payload: bytes) -> None:
+        if payload[:4] == magic:
+            handler(sender, payload)
+        elif prev is not None:
+            prev(sender, payload)
+
+    demux.bytes_handler = on_bytes

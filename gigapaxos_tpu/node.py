@@ -100,8 +100,17 @@ class InProcessCluster:
         rc_apps = [ReconfiguratorDB(r) for r in rc_ids] + [
             ReconfiguratorDB(f"_spare{i}") for i in range(spare_rc_slots)
         ]
-        self.rc_manager = PaxosManager(cfg, len(rc_apps), rc_apps, wal=rc_wal,
-                                       spill_ns="rc")
+        # the RC DB is a host state machine: a device-app data plane must
+        # not leak its mode into the control plane's manager
+        rc_cfg = cfg
+        if cfg.paxos.device_app:
+            import copy as _copy
+            import dataclasses as _dc
+
+            rc_cfg = _copy.copy(cfg)
+            rc_cfg.paxos = _dc.replace(cfg.paxos, device_app=False)
+        self.rc_manager = PaxosManager(rc_cfg, len(rc_apps), rc_apps,
+                                       wal=rc_wal, spill_ns="rc")
         self.rdb = RepliconfigurableReconfiguratorDB(
             self.rc_manager, rc_ids, k=rc_group_size
         )

@@ -64,7 +64,17 @@ class TickDriver:
         drain = self.drain_ticks
         lock = getattr(self.manager, "lock", None)
         counted = hasattr(lock, "waiters")
+        min_ivl = getattr(
+            getattr(self.manager.cfg, "paxos", None),
+            "min_tick_interval_s", 0.0,
+        ) or 0.0
+        last = 0.0
         while not self._stop.is_set():
+            if min_ivl > 0:
+                gap = min_ivl - (time.monotonic() - last)
+                if gap > 0:
+                    time.sleep(gap)  # coalesce: let requests accumulate
+                last = time.monotonic()
             out = self.manager.tick()
             self._first_tick.set()
             # CPython locks are unfair: without a yield window the driver

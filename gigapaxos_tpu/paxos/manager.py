@@ -139,10 +139,37 @@ class PaxosManager:
         bc = cfg.paxos.bulk_capacity or max(1 << 16, 4 * self.G)
         self._bulk_cap = 1 << (bc - 1).bit_length()
         self.bulk: Optional[BulkStore] = None  # lazy (most managers: unused)
+        self._bulk_cbs: Dict[int, Callable] = {}  # optional per-rid cbs
         self._bulk_chunks: list = []  # FIFO of staged rid arrays
         self._bulk_leftover = np.zeros(0, np.int64)  # queued, not yet placed
         self._bulk_placed = None  # (rids, entries, ps, rows) of last tick
         self._lag_pending = (np.zeros(0, np.int64), np.zeros(0, np.int64))
+        # ---- device-resident application (models/device_kv.py) ----
+        self._device_app = bool(cfg.paxos.device_app)
+        self.kv = None
+        if self._device_app:
+            if not self._use_compact:
+                raise ValueError("device_app requires compact_outbox")
+            from ..models.device_kv import DeviceKVApp, init_kv
+
+            table = cfg.paxos.kv_table or (
+                1 << max(16, (4 * self.G - 1).bit_length())
+            )
+            # live-descriptor evictions must be impossible: rids are
+            # sequential and the admit window caps live spread at
+            # bulk_capacity, so a table >= 2x that can only ever evict
+            # descriptors of already-freed requests
+            table = max(table, 2 * self._bulk_cap)
+            self.kv = init_kv(self.R, self.G, cfg.paxos.kv_slots, table)
+            # the manager owns the device state; the Replicable faces the
+            # control plane sees are row-granular views of it
+            self.apps = [DeviceKVApp(self, r, row_of=self.rows.row)
+                         for r in range(self.R)]
+            apps = self.apps
+            self._kv_reg_budget = cfg.paxos.kv_reg_budget or 2 * self.G
+            self._kv_chunks: list = []  # staged descriptor uploads
+            self._kv_watermark = 0  # highest rid with descriptor on device
+            self._kv_uploaded = None  # this tick's upload (journaled)
         # first-occurrence scratch (generation-tagged so no per-tick clear)
         self._scr_pos = np.zeros(self.R * self.G, np.int64)
         self._scr_gen = np.zeros(self.R * self.G, np.int64)
@@ -243,9 +270,13 @@ class PaxosManager:
         self._fail_queued(row)
         self._purge_row_outstanding(row)
         if self.bulk is not None:
-            self.stats["failed_requests"] += self.bulk.fail(
-                np.nonzero(self.bulk.valid & (self.bulk.row == row))[0]
-            )
+            gone = np.nonzero(self.bulk.valid & (self.bulk.row == row))[0]
+            if len(gone):
+                if self._bulk_cbs:
+                    self._bulk_fire(
+                        self.bulk.rid[gone[~self.bulk.responded[gone]]]
+                    )
+                self.stats["failed_requests"] += self.bulk.fail(gone)
         self._stopped_rows.discard(row)
         self._stopped_np[row] = False
         if self.wal is not None:
@@ -538,18 +569,29 @@ class PaxosManager:
         return self._member_ord
 
     @_locked
-    def propose_bulk(self, rows, payloads, stops=None) -> np.ndarray:
+    def propose_bulk(self, rows, payloads, stops=None,
+                     callbacks=None, entries=None) -> np.ndarray:
         """Vectorized propose: admit one request per entry of ``rows`` (row
         indices into the group table) in a single columnar operation.
 
         ``payloads``: one bytes object (shared by all — generated-load
         fan-out) or a sequence of per-request bytes.  Returns the assigned
-        rid array (int64), -1 where the target row was unknown/stopped.
-        No per-request callbacks ride this path: completion is observable
-        through :meth:`bulk_stats` (the open-loop TESTPaxosClient model,
-        ``testing/TESTPaxosClient.java:59``); response payloads for entry
-        replicas are retained in the store until the request is freed.
+        rid array (int64); negative entries were not admitted and no
+        callback fires for them: -1 = target row unknown/stopped (client
+        must re-resolve), -2 = store window full (transient backpressure —
+        plain retry, nothing is wrong with the placement).
+        ``callbacks``: optional per-request
+        ``cb(rid, response_or_None)`` list aligned with ``rows``; fires
+        through the durability-gated callback queue exactly like scalar
+        proposes (log-before-respond).  Without callbacks, completion is
+        observable through :meth:`bulk_stats` / :meth:`bulk_response`
+        (the open-loop TESTPaxosClient model, testing/TESTPaxosClient.java:59).
         """
+        if self._device_app and not getattr(self, "_in_kv_admit", False):
+            raise ValueError(
+                "device-app managers admit bulk work via propose_bulk_kv "
+                "(a plain payload has no descriptor and could never place)"
+            )
         store = self._ensure_bulk()
         rows = np.asarray(rows, np.int64)
         out = np.full(len(rows), -1, np.int64)
@@ -563,6 +605,8 @@ class PaxosManager:
                 stops = stops[ok]
             if not isinstance(payloads, (bytes, bytearray)):
                 payloads = [p for p, o in zip(payloads, ok) if o]
+            if callbacks is not None:
+                callbacks = [c for c, o in zip(callbacks, ok) if o]
         n = len(rows)
         if n == 0:
             return out
@@ -583,28 +627,121 @@ class PaxosManager:
             raise OverflowError("rid space exhausted (int32 device ids)")
         if n_adm == 0:
             self.stats["backpressured"] += n
+            out[ok] = -2
             return out
         if n_adm < n:
             self.stats["backpressured"] += n - n_adm
+            out[np.nonzero(ok)[0][n_adm:]] = -2
             rows = rows[:n_adm]
             if stops is not None:
                 stops = stops[:n_adm]
             if not isinstance(payloads, (bytes, bytearray)):
                 payloads = payloads[:n_adm]
         # spread entry duty across each group's members by rid rotation
+        # (or pin it to a requested member — the edge node that owns the
+        # client connection — falling back to rotation for non-members)
         nm = self._n_members_np[rows]
         k = ((rid0 + np.arange(n_adm)) % nm).astype(np.int32)
         om = self._member_ordinals()
-        entries = np.zeros(n_adm, np.int32)
+        ent = np.zeros(n_adm, np.int32)
         for r in range(self.R):
             sel = self._member_np[r, rows] & (om[r, rows] == k)
-            entries[sel] = r
-        rids = store.admit(rid0, rows.astype(np.int32), entries, stops,
+            ent[sel] = r
+        if entries is not None:
+            e = int(entries)
+            ent = np.where(self._member_np[e, rows], e, ent).astype(np.int32)
+        rids = store.admit(rid0, rows.astype(np.int32), ent, stops,
                            payloads)
+        if callbacks is not None:
+            for rid, cb in zip(rids, callbacks):
+                if cb is not None:
+                    self._bulk_cbs[int(rid)] = cb
         self._bulk_chunks.append(rids)
         self._last_active[rows] = self.tick_num
         out[np.nonzero(ok)[0][:n_adm]] = rids
         return out
+
+    def _bulk_fire(self, rids, responses=None) -> None:
+        """Queue completion callbacks for bulk rids that just reached their
+        responded transition (durability-gated like every response)."""
+        if not self._bulk_cbs:
+            return
+        if responses is None:
+            for rid in rids:
+                cb = self._bulk_cbs.pop(int(rid), None)
+                if cb is not None:
+                    self._held_callbacks.append((cb, int(rid), None))
+        else:
+            import struct as _struct
+
+            for rid, resp in zip(rids, responses):
+                cb = self._bulk_cbs.pop(int(rid), None)
+                if cb is not None:
+                    if resp is not None and not isinstance(
+                        resp, (bytes, bytearray)
+                    ):
+                        # device-app responses are i32 scalars
+                        resp = _struct.pack("<i", int(resp))
+                    self._held_callbacks.append((cb, int(rid), resp))
+
+    @_locked
+    def propose_bulk_kv(self, rows, ops, keys, vals,
+                        callbacks=None, entries=None) -> np.ndarray:
+        """Device-app propose: admit requests whose execution is a KV
+        descriptor (op, key, val) uploaded to the device table inside the
+        fused tick — the decision stream never surfaces as host work.
+        Returns rids like :meth:`propose_bulk` (-1 = rejected)."""
+        assert self._device_app, "propose_bulk_kv needs cfg.paxos.device_app"
+        self._in_kv_admit = True
+        try:
+            out = self.propose_bulk(rows, b"", callbacks=callbacks,
+                                    entries=entries)
+        finally:
+            self._in_kv_admit = False
+        adm = out >= 0
+        if adm.any():
+            self._kv_chunks.append((
+                out[adm],
+                np.asarray(ops, np.int32)[adm],
+                np.asarray(keys, np.int32)[adm],
+                np.asarray(vals, np.int32)[adm],
+            ))
+        return out
+
+    def _take_kv_uploads(self):
+        """Pull up to kv_reg_budget staged descriptors for this tick's
+        fused upload; advances the placement watermark.  Returns padded
+        [K] arrays (rid 0 = empty slot)."""
+        K = self._kv_reg_budget
+        take, total = [], 0
+        while self._kv_chunks and total < K:
+            c = self._kv_chunks[0]
+            room = K - total
+            if len(c[0]) <= room:
+                take.append(c)
+                total += len(c[0])
+                self._kv_chunks.pop(0)
+            else:
+                take.append(tuple(a[:room] for a in c))
+                self._kv_chunks[0] = tuple(a[room:] for a in c)
+                total += room
+        rids = np.zeros(K, np.int32)
+        ops = np.zeros(K, np.int32)
+        keys = np.zeros(K, np.int32)
+        vals = np.zeros(K, np.int32)
+        o = 0
+        for c in take:
+            n = len(c[0])
+            rids[o:o + n] = c[0]
+            ops[o:o + n] = c[1]
+            keys[o:o + n] = c[2]
+            vals[o:o + n] = c[3]
+            o += n
+        if o:
+            self._kv_watermark = max(self._kv_watermark, int(rids[:o].max()))
+        self._kv_uploaded = (rids[:o].copy(), ops[:o].copy(),
+                             keys[:o].copy(), vals[:o].copy()) if o else None
+        return rids, ops, keys, vals
 
     def bulk_response(self, rid: int):
         """Response payload of an entry-replica-completed bulk request.
@@ -743,12 +880,25 @@ class PaxosManager:
         # rows gone dead under queued requests (removed/stopped): drop them
         bad = (self._n_members_np[rows] == 0) | self._stopped_np[rows]
         if bad.any():
+            if self._bulk_cbs:
+                self._bulk_fire(q[bad])  # group gone: cb(None), client retries
             store.fail(idx[bad])
             self.stats["failed_requests"] += int(bad.sum())
             q, idx, rows = q[~bad], idx[~bad], rows[~bad]
         if not len(q):
             self._bulk_leftover = np.zeros(0, np.int64)
             return
+        hold = np.zeros(0, np.int64)
+        if self._device_app:
+            # a request may only be placed once its descriptor upload is on
+            # (or riding to) the device — rids beyond the watermark wait
+            wm = q <= self._kv_watermark
+            if not wm.all():
+                hold = q[~wm]
+                q, idx, rows = q[wm], idx[wm], rows[wm]
+                if not len(q):
+                    self._bulk_leftover = hold
+                    return
         entries = store.entry[idx]
         if not self.alive.all():
             # re-home requests whose entry replica is dead to the first
@@ -776,32 +926,48 @@ class PaxosManager:
         else:
             qk = np.zeros(0, np.int64)
         key = (entries.astype(np.int64) * self.G + rows).astype(np.intp)
-        first = self._first_occurrence(key, self._scr_pos, self._scr_gen)
+        # up to P requests per (entry, row) per tick: P first-occurrence
+        # passes assign p slots in arrival order (device admission is FIFO
+        # across p for one entry, so per-key order is preserved)
+        p = np.full(len(q), -1, np.int32)
+        remaining = np.arange(len(q))
+        for pp in range(self.P):
+            if not len(remaining):
+                break
+            fo = self._first_occurrence(key[remaining], self._scr_pos,
+                                        self._scr_gen)
+            p[remaining[fo]] = pp
+            remaining = remaining[~fo]
         # collision with slow-path placements at the same (entry, row):
-        # shift this tick's bulk entry up past the used p slots
-        p = np.zeros(len(q), np.int32)
+        # shift this tick's bulk entries up past the used p slots
         if placed:
             used = collections.Counter()
             for row_, take in placed:
                 for _rid, e_, _p in take:
                     used[(e_, row_)] += 1
             for (e_, row_), cnt in used.items():
-                p[(entries == e_) & (rows == row_)] += cnt
-        fit = first & (p < self.P)
+                sel = (entries == e_) & (rows == row_) & (p >= 0)
+                p[sel] += cnt
+        fit = (p >= 0) & (p < self.P)
         if fit.any():
             fe, fp, fr = entries[fit], p[fit], rows[fit]
             req[fe, fp, fr] = q[fit].astype(np.int32)
             stp[fe, fp, fr] = store.stop[idx[fit]]
             self._bulk_placed = (q[fit], fe, fp, fr)
         rest = q[~fit]
-        self._bulk_leftover = (np.concatenate([rest, qk])
-                               if qk.size else rest)
+        parts = [p for p in (rest, hold, qk) if p.size]
+        self._bulk_leftover = (np.concatenate(parts) if len(parts) > 1
+                               else (parts[0] if parts else rest))
 
     @_locked
     def tick(self):
         """One manager step.  Returns the tick's :class:`HostOutbox` (full
         mode) / :class:`CompactHostOutbox` (compact mode); in pipelined mode
         the return is the PREVIOUS tick's outbox (None on the first)."""
+        if self._device_app:
+            # descriptor upload rides the same fused program as the tick;
+            # watermark must advance BEFORE the build so those rids place
+            reg = self._take_kv_uploads()
         inbox = self._build_inbox()
         placed = self._placed
         bulk_placed = self._bulk_placed
@@ -809,7 +975,14 @@ class PaxosManager:
         # while the WAL appends+fsyncs this tick's record (SURVEY §2.2 item 3,
         # the BatchedLogger overlap, AbstractPaxosLogger.java:99-107).  Safe
         # because responses stay held until is_synced() (log-before-respond).
-        if self._use_compact:
+        if self._device_app:
+            from ..models.device_kv import fused_compact
+
+            self.state, self.kv, packed = fused_compact(
+                self.state, self.kv, inbox, *reg, -1,
+                self._exec_budget, self._lag_budget,
+            )
+        elif self._use_compact:
             self.state, packed = paxos_tick_compact(
                 self.state, inbox, -1, self._exec_budget, self._lag_budget
             )
@@ -849,9 +1022,16 @@ class PaxosManager:
         requeue rejected intake, execute the ordered decision stream,
         release durable callbacks, periodic GC."""
         if self._use_compact:
-            out = unpack_compact(packed, self.R, self.G,
+            flat = np.asarray(packed)
+            out = unpack_compact(flat, self.R, self.G,
                                  self._exec_budget, self._lag_budget)
-            self._process_compact(out, placed, bulk_placed)
+            e_resp = e_miss = None
+            if self._device_app:
+                E = self._exec_budget
+                base = 3 + self.R * self.G + 4 * E + 2 * self._lag_budget
+                e_resp = flat[base:base + E]
+                e_miss = flat[base + E:base + 2 * E]
+            self._process_compact(out, placed, bulk_placed, e_resp, e_miss)
         else:
             out = (packed if isinstance(packed, HostOutbox)
                    else unpack_outbox(packed, self.R, self.P, self.W, self.G))
@@ -980,6 +1160,8 @@ class PaxosManager:
         if s.entry[sidx] == r and not s.responded[sidx]:
             s.responded[sidx] = True
             s.response[sidx] = resp
+            if self._bulk_cbs:
+                self._bulk_fire([rid], [resp if resp is not None else b""])
         full = self._member_bits[row]
         if s.responded[sidx] and (s.exec_mask[sidx] & full) == full:
             s.valid[sidx] = False
@@ -989,10 +1171,17 @@ class PaxosManager:
             s.done += 1
 
     def _process_compact(self, co: CompactHostOutbox, placed=None,
-                         bulk_placed=None) -> None:
+                         bulk_placed=None, e_resp=None,
+                         e_miss=None) -> None:
         """Vectorized twin of :meth:`_process_outbox` over the compacted
         stream: every lifecycle step is an index-array operation; only
-        stops and non-store (dict) requests fall back to per-item code."""
+        stops and non-store (dict) requests fall back to per-item code.
+
+        e_resp/e_miss: device-app extras aligned with the exec stream —
+        per-execution KV responses and descriptor-miss flags.  Misses
+        route through the scalar path, whose app ``execute`` re-applies
+        the descriptor host-side (or fails the request if the payload is
+        gone)."""
         taken = co.taken_bits
         for row, take in (placed or []):
             for rid, entry, p in reversed(take):
@@ -1025,9 +1214,16 @@ class PaxosManager:
                 ok &= valid
             else:
                 idx, ok = None, np.zeros(n, bool)
-            # stops and dict-path/orphan rids: scalar path (rare at scale)
+            # stops, dict-path/orphan rids, and device-app descriptor
+            # misses: scalar path (rare at scale)
             per_item = (valid & ~ok) | stops
             vec = ok & ~stops
+            if e_miss is not None:
+                miss = e_miss[:n].astype(bool) & valid
+                if miss.any():
+                    self.stats["kv_misses"] += int(miss.sum())
+                    per_item |= miss
+                    vec &= ~miss
             for i in np.nonzero(per_item)[0]:
                 row = int(rows[i])
                 name = self.rows.name(row)
@@ -1062,13 +1258,21 @@ class PaxosManager:
                     continue
                 ns = store.slot[idx_r] < 0
                 store.slot[idx_r[ns]] = slot_r[ns]
-                erb = getattr(self.apps[r], "execute_rows_batch", None)
-                if erb is not None:
-                    resp = erb(row_r, store.payload[idx_r], rid_r)
+                if e_resp is not None:
+                    # device app: execution already happened on-device
+                    # inside the fused tick; only responses surface
+                    resp = e_resp[:n][sel][fo]
+                    if not fresh.all():
+                        resp = resp[fresh]
                 else:
-                    resp = self.apps[r].execute_batch(
-                        self._row_name_np[row_r], store.payload[idx_r], rid_r
-                    )
+                    erb = getattr(self.apps[r], "execute_rows_batch", None)
+                    if erb is not None:
+                        resp = erb(row_r, store.payload[idx_r], rid_r)
+                    else:
+                        resp = self.apps[r].execute_batch(
+                            self._row_name_np[row_r], store.payload[idx_r],
+                            rid_r
+                        )
                 self.stats["executions"] += len(idx_r)
                 em = (store.entry[idx_r] == r) & ~store.responded[idx_r]
                 ri = idx_r[em]
@@ -1078,6 +1282,11 @@ class PaxosManager:
                         ra = np.empty(len(resp), object)
                         ra[:] = resp
                         store.response[ri] = ra[em]
+                        if self._bulk_cbs:
+                            self._bulk_fire(store.rid[ri], list(ra[em]))
+                    elif self._bulk_cbs:
+                        self._bulk_fire(store.rid[ri],
+                                        [b""] * len(ri))
                 touched.append(idx_r)
             if touched:
                 ti = np.concatenate(touched)
@@ -1193,6 +1402,8 @@ class PaxosManager:
                 s.exec_mask[sel] |= np.int64(1) << r
                 ent = (s.entry[sel] == r) & ~s.responded[sel]
                 s.responded[sel[ent]] = True
+                if self._bulk_cbs and ent.any():
+                    self._bulk_fire(s.rid[sel[ent]])  # duty skipped: None
                 s.free_done(sel, self._member_bits[s.row[sel]])
         self.stats["checkpoint_transfers"] += 1
         return True

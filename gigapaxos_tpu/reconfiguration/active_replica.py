@@ -29,6 +29,9 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+import numpy as np
+
+from ..net import binbatch
 from ..net.bulk import BulkTransfer
 from ..net.messenger import Messenger
 from ..protocoltask.executor import ProtocolExecutor, ProtocolTask
@@ -36,6 +39,13 @@ from . import packets as pkt
 from .consistent_hashing import ConsistentHashRing
 from .coordinator import AbstractReplicaCoordinator
 from .demand import AbstractDemandProfile, DemandProfile
+
+
+#: batch-admission rejection codes -> client-visible errors.  "busy" is
+#: transient backpressure: retry at the SAME active (re-resolving actives
+#: on it would storm the RC plane for nothing); "not_active" means
+#: re-resolve; "bad_request" is permanent.
+_REJECT = {1: "not_active", 2: "busy", 3: "bad_request"}
 
 
 class WaitEpochFinalState(ProtocolTask):
@@ -109,6 +119,9 @@ class ActiveReplica:
         # (LargeCheckpointer analog, paxosutil/LargeCheckpointer.java:39)
         self.bulk = BulkTransfer(self.m)
         self.bulk.register_prefix("efs:", self._on_bulk_final_state)
+        # binary batched-request frames (SoA wire, net/binbatch.py)
+        binbatch.chain_bytes_handler(self.m.demux, binbatch.REQ_MAGIC,
+                                     self._on_binary_batch)
         # (client, rid) -> None while in flight, response packet once done;
         # absorbs same-rid retransmissions (GCConcurrentHashMap analog)
         self._req_dedup: "collections.OrderedDict[tuple, Optional[dict]]" = (
@@ -129,6 +142,7 @@ class ActiveReplica:
         self._any_next = 1 << 40  # disjoint from client rids
         for ptype, h in [
             (pkt.APP_REQUEST, self._on_app_request),
+            (pkt.APP_REQUEST_BATCH, self._on_app_request_batch),
             (pkt.ACTIVES_RESPONSE, self._on_actives_response),
             (pkt.STOP_EPOCH, self._on_stop_epoch),
             (pkt.START_EPOCH, self._on_start_epoch),
@@ -162,39 +176,11 @@ class ActiveReplica:
         # (its response will carry the same rid) and one arriving after
         # completion gets the cached response instead of a second proposal
         key = (sender, rid)
-        with self._dedup_lock:
-            if key in self._req_dedup:
-                cached = self._req_dedup[key]
-                if cached is not None:
-                    self.m.send(sender, cached)
-                return
-            self._req_dedup[key] = None
-            self._dedup_born[key] = time.monotonic()
-            if len(self._req_dedup) > self._dedup_cap:
-                # evict the oldest COMPLETED entry — dropping a live
-                # in-flight (None) marker would let a retransmission of a
-                # slow request start the second proposal the map exists to
-                # prevent.  Scan stops at the first completed key (usually
-                # the very first), no full-copy of the map on the hot path.
-                victim = None
-                for k in self._req_dedup:
-                    if self._req_dedup[k] is not None:
-                        victim = k
-                        break
-                if victim is not None:
-                    del self._req_dedup[victim]
-                else:
-                    # all in-flight: age out markers past the max plausible
-                    # commit latency (dead clients / wedged groups) so the
-                    # map stays bounded under pathological load
-                    now = time.monotonic()
-                    stale = [
-                        k for k, born in self._dedup_born.items()
-                        if now - born > self._dedup_inflight_ttl_s
-                    ]
-                    for k in stale:
-                        self._req_dedup.pop(k, None)
-                        self._dedup_born.pop(k, None)
+        dup, cached = self._dedup_check_insert(key)
+        if dup:
+            if cached is not None:
+                self.m.send(sender, cached)
+            return
         try:
             self._handle_app_request(sender, p, key)
         except Exception:
@@ -205,6 +191,43 @@ class ActiveReplica:
                 self._req_dedup.pop(key, None)
                 self._dedup_born.pop(key, None)
             raise
+
+    def _dedup_check_insert(self, key):
+        """Dedup-map admission shared by the scalar and batch paths.
+        Returns (is_duplicate, cached_response_or_None); on a miss inserts
+        the in-flight marker and enforces the cap: evict the oldest
+        COMPLETED entry — dropping a live in-flight (None) marker would
+        let a retransmission of a slow request start the second proposal
+        the map exists to prevent — and when all entries are in-flight,
+        age out markers past the max plausible commit latency."""
+        now = time.monotonic()
+        with self._dedup_lock:
+            if key in self._req_dedup:
+                return True, self._req_dedup[key]
+            self._req_dedup[key] = None
+            self._dedup_born[key] = now
+            if len(self._req_dedup) > self._dedup_cap:
+                victim = None
+                for k in self._req_dedup:
+                    if self._req_dedup[k] is not None:
+                        victim = k
+                        break
+                if victim is not None:
+                    del self._req_dedup[victim]
+                else:
+                    stale = [
+                        k for k, born in self._dedup_born.items()
+                        if now - born > self._dedup_inflight_ttl_s
+                    ]
+                    for k in stale:
+                        self._req_dedup.pop(k, None)
+                        self._dedup_born.pop(k, None)
+        return False, None
+
+    def _dedup_clear(self, key) -> None:
+        with self._dedup_lock:
+            self._req_dedup.pop(key, None)
+            self._dedup_born.pop(key, None)
 
     def _handle_app_request(self, sender: str, p: dict, key) -> None:
         name, rid = p["name"], p["rid"]
@@ -257,6 +280,196 @@ class ActiveReplica:
                 with self._dedup_lock:
                     self._req_dedup.pop(key, None)
                     self._dedup_born.pop(key, None)
+
+    def _on_app_request_batch(self, sender: str, p: dict) -> None:
+        """Coalesced client edge: one frame of requests in, one frame of
+        responses out (RequestPacket.java:189-233 ``batched[]``).  Dedup is
+        batch-granular — the batch id, not each rid, keys the
+        retransmission cache, so absorbing a retransmitted batch costs one
+        map lookup instead of len(batch)."""
+        pkt.register_client(self.m.nodemap, p)
+        reply_to = p.get("reply_to") or sender
+        bid = p["bid"]
+        key = (reply_to, ("b", bid))
+        dup, cached = self._dedup_check_insert(key)
+        if dup:
+            if cached is not None:
+                self.m.send(reply_to, cached)
+            return
+        reqs = p["reqs"]
+        if not reqs:
+            self._dedup_clear(key)
+            self.m.send(reply_to, {"type": pkt.APP_RESPONSE_BATCH,
+                                   "bid": bid, "results": []})
+            return
+        results: list = [None] * len(reqs)
+        lock = threading.Lock()
+        remaining = [len(reqs)]
+
+        def finish() -> None:
+            resp = {"type": pkt.APP_RESPONSE_BATCH, "bid": bid,
+                    "results": results}
+            # like the scalar path, only all-success frames are cached for
+            # retransmission replay; a frame with transient failures clears
+            # the marker so a retry can re-coordinate
+            with self._dedup_lock:
+                if all(r[1] for r in results):
+                    self._req_dedup[key] = resp
+                else:
+                    self._req_dedup.pop(key, None)
+                self._dedup_born.pop(key, None)
+            self.m.send(reply_to, resp)
+
+        def settle(i: int, rid, entry) -> None:
+            results[i] = entry
+            with lock:
+                remaining[0] -= 1
+                done = remaining[0] == 0
+            if done:
+                finish()
+
+        # demand accounting once per (name, batch), not per request
+        name_counts: Dict[str, int] = {}
+        for name, _rid, _pl in reqs:
+            name_counts[name] = name_counts.get(name, 0) + 1
+        for name, cnt in name_counts.items():
+            epoch = self.coord.current_epoch(name)
+            if epoch is not None:
+                self._register_demand_batch(name, reply_to, epoch, cnt)
+        def make_cb(i: int, rid):
+            def cb(req_id: int, resp) -> None:
+                if req_id < 0 or resp is None:
+                    settle(i, rid, [rid, False, "stopped"])
+                else:
+                    settle(i, rid, [rid, True, pkt.b64e(resp)])
+
+            return cb
+
+        try:
+            crb = getattr(self.coord, "coordinate_requests_batch", None)
+            if crb is not None:
+                # columnar admission: the whole frame enters the manager's
+                # bulk path in one operation
+                items, live_idx = [], []
+                for i, (name, rid, payload_b64) in enumerate(reqs):
+                    epoch = self.coord.current_epoch(name)
+                    if epoch is None:
+                        settle(i, rid, [rid, False, "not_active"])
+                        continue
+                    items.append((name, epoch, pkt.b64d(payload_b64) or b"",
+                                  make_cb(i, rid)))
+                    live_idx.append(i)
+                if items:
+                    rids2 = crb(items, entry=self.node_id)
+                    for i, r2 in zip(live_idx, rids2):
+                        if r2 < 0:
+                            rid = reqs[i][1]
+                            settle(i, rid, [rid, False, _REJECT[min(-r2, 3)]])
+                return
+            for i, (name, rid, payload_b64) in enumerate(reqs):
+                epoch = self.coord.current_epoch(name)
+                if epoch is None:
+                    settle(i, rid, [rid, False, "not_active"])
+                    continue
+                r = self.coord.coordinate_request(
+                    name, epoch, pkt.b64d(payload_b64) or b"",
+                    make_cb(i, rid), entry=self.node_id,
+                )
+                if r is None:
+                    settle(i, rid, [rid, False, "not_active"])
+        except Exception:
+            # never strand the in-flight marker: a parse/admission error
+            # must not black-hole every retransmission of this bid
+            self._dedup_clear(key)
+            raise
+
+    def _on_binary_batch(self, sender: str, buf: bytes) -> None:
+        """Binary twin of :meth:`_on_app_request_batch`: columnar decode,
+        one bulk admission, columnar response frame."""
+        (bid, addr, client_id, names, name_idx, rids,
+         payloads) = binbatch.decode_request(buf)
+        if self.m.nodemap(client_id) is None:
+            self.m.nodemap.add(client_id, addr[0], int(addr[1]))
+        key = (client_id, ("bb", bid))
+        dup, cached = self._dedup_check_insert(key)
+        if dup:
+            if cached is not None:
+                self.m.send_bytes(client_id, cached)
+            return
+        n = len(rids)
+        if n == 0:
+            self._dedup_clear(key)
+            self.m.send_bytes(client_id,
+                              binbatch.encode_response(bid, [], [], []))
+            return
+        statuses = np.zeros(n, np.uint8)
+        bodies: list = [b""] * n
+        lock = threading.Lock()
+        remaining = [n]
+
+        def finish() -> None:
+            frame = binbatch.encode_response(bid, rids, statuses, bodies)
+            # cache only all-success frames (see _on_app_request_batch)
+            with self._dedup_lock:
+                if statuses.all():
+                    self._req_dedup[key] = frame
+                else:
+                    self._req_dedup.pop(key, None)
+                self._dedup_born.pop(key, None)
+            self.m.send_bytes(client_id, frame)
+
+        def settle(i: int, ok: bool, body: bytes) -> None:
+            statuses[i] = 1 if ok else 0
+            bodies[i] = body
+            with lock:
+                remaining[0] -= 1
+                done = remaining[0] == 0
+            if done:
+                finish()
+
+        epochs = [self.coord.current_epoch(nm) for nm in names]
+        counts = np.bincount(name_idx, minlength=len(names))
+        for j, nm in enumerate(names):
+            if epochs[j] is not None and counts[j]:
+                self._register_demand_batch(nm, client_id, epochs[j],
+                                            int(counts[j]))
+
+        def make_cb(i: int):
+            def cb(req_id: int, resp) -> None:
+                if req_id < 0 or resp is None:
+                    settle(i, False, b"stopped")
+                else:
+                    settle(i, True, resp)
+
+            return cb
+
+        try:
+            crb = getattr(self.coord, "coordinate_requests_batch", None)
+            items, live_idx = [], []
+            for i in range(n):
+                ep = epochs[name_idx[i]]
+                if ep is None:
+                    settle(i, False, b"not_active")
+                    continue
+                if crb is not None:
+                    items.append((names[name_idx[i]], ep, payloads[i],
+                                  make_cb(i)))
+                    live_idx.append(i)
+                else:
+                    r = self.coord.coordinate_request(
+                        names[name_idx[i]], ep, payloads[i], make_cb(i),
+                        entry=self.node_id,
+                    )
+                    if r is None:
+                        settle(i, False, b"not_active")
+            if items:
+                out = crb(items, entry=self.node_id)
+                for i, r2 in zip(live_idx, out):
+                    if r2 < 0:
+                        settle(i, False, _REJECT[min(-r2, 3)].encode())
+        except Exception:
+            self._dedup_clear(key)
+            raise
 
     def _anycast_forward(self, reply_to: str, p: dict) -> None:
         """Resolve the name's actives from its RC group, then re-send the
@@ -312,11 +525,18 @@ class ActiveReplica:
         self.m.send(sender, packet)
 
     def _register_demand(self, name: str, sender: str, epoch: int) -> None:
+        self._register_demand_batch(name, sender, epoch, 1)
+
+    def _register_demand_batch(self, name: str, sender: str, epoch: int,
+                               n: int) -> None:
         with self._plock:
             prof = self._profiles.get(name)
             if prof is None:
                 prof = self._profiles[name] = self.profile_factory(name)
-            prof.register_request(sender)
+            if n == 1:
+                prof.register_request(sender)
+            else:
+                prof.register_requests(sender, n)
             stats = prof.get_stats() if prof.should_report() else None
         if stats is not None:
             # ship to the name's RC group (handleDemandReport aggregates and

@@ -138,6 +138,72 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
             self._pax_name(name, epoch), payload, callback, entry=slot
         )
 
+    def coordinate_requests_batch(self, items, entry: Optional[str] = None):
+        """Batch twin of :meth:`coordinate_request` feeding the manager's
+        vectorized propose path (one columnar admission for the whole
+        frame instead of a per-request staged propose).
+
+        items: (name, epoch, payload, callback) tuples.  Returns a list of
+        rids aligned with items (-1 = rejected: wrong epoch / unknown row /
+        admission backpressure; no callback fires for those).
+        """
+        import numpy as np
+
+        slot = self._slot.get(entry) if entry is not None else None
+        rows = np.empty(len(items), np.int64)
+        # cache keyed by (name, epoch): a batch straddling a reconfiguration
+        # must reject stale-epoch entries exactly like coordinate_request
+        row_cache: Dict[tuple, int] = {}
+        payloads, cbs = [], []
+        reject = []
+        for i, (name, epoch, payload, cb) in enumerate(items):
+            row = row_cache.get((name, epoch))
+            if row is None:
+                if self._epoch.get(name) != epoch:
+                    row = -1
+                else:
+                    row = self.manager.rows.row(self._pax_name(name, epoch))
+                    if row is None:
+                        row = -1
+                row_cache[(name, epoch)] = row
+            if row < 0:
+                reject.append(i)
+            rows[i] = row
+            payloads.append(payload)
+            cbs.append(cb)
+        sel = rows >= 0
+        out = np.full(len(items), -1, np.int64)
+        if sel.any():
+            sel_payloads = [p for p, s in zip(payloads, sel) if s]
+            sel_cbs = [c for c, s in zip(cbs, sel) if s]
+            if getattr(self.manager, "_device_app", False):
+                # device app: payloads ARE 12-byte descriptors; decode the
+                # frame columnar and admit through the kv path.  A
+                # malformed payload rejects individually (-3) — it must
+                # not black-hole the frame's valid requests.
+                from ..models.device_kv import DESC_LEN
+
+                good = np.fromiter(
+                    (len(p) == DESC_LEN for p in sel_payloads),
+                    bool, len(sel_payloads),
+                )
+                si = np.nonzero(sel)[0]
+                out[si[~good]] = -3
+                if good.any():
+                    gp = [p for p, g in zip(sel_payloads, good) if g]
+                    d = np.frombuffer(b"".join(gp), np.int32).reshape(-1, 3)
+                    out[si[good]] = self.manager.propose_bulk_kv(
+                        rows[sel][good], d[:, 0], d[:, 1], d[:, 2],
+                        callbacks=[c for c, g in zip(sel_cbs, good) if g],
+                        entries=slot,
+                    )
+            else:
+                out[sel] = self.manager.propose_bulk(
+                    rows[sel], sel_payloads, callbacks=sel_cbs,
+                    entries=slot,
+                )
+        return list(out)
+
     def create_replica_group(
         self, name: str, epoch: int, initial_state: bytes, nodes: List[str]
     ) -> bool:

@@ -31,6 +31,14 @@ class AbstractDemandProfile(abc.ABC):
         """Fold one client request into the profile (sender = client id/addr,
         used by geo-aware policies)."""
 
+    def register_requests(self, sender: Optional[str], n: int,
+                          now: Optional[float] = None) -> None:
+        """Fold ``n`` requests from one sender at once (the batched client
+        edge registers demand per frame, not per request).  Default loops;
+        profiles override with O(1) math."""
+        for _ in range(n):
+            self.register_request(sender, now)
+
     @abc.abstractmethod
     def should_report(self) -> bool:
         """True when the active should ship a DemandReport now
@@ -61,6 +69,11 @@ class DemandProfile(AbstractDemandProfile):
     def __init__(
         self,
         name: str,
+        # the reference's cadence: report after every request
+        # (DemandProfile.java:126 minRequestsBeforeDemandReport).  At high
+        # rates a per-request report to the whole RC group dominates the
+        # edge (3 frames per request) — deployments chasing throughput
+        # raise this via their profile factory (capacity.py uses 64).
         min_requests_before_report: int = 1,
         min_interval_s: float = 0.0,
         min_requests_between: int = 1,
@@ -86,6 +99,26 @@ class DemandProfile(AbstractDemandProfile):
             self.by_sender[sender] = self.by_sender.get(sender, 0) + 1
         if self._last_request_t > 0:
             ia = now - self._last_request_t
+            self.inter_arrival_ewma = (
+                ia
+                if self.inter_arrival_ewma == 0
+                else 0.9 * self.inter_arrival_ewma + 0.1 * ia
+            )
+        self._last_request_t = now
+
+    def register_requests(self, sender: Optional[str], n: int,
+                          now: Optional[float] = None) -> None:
+        """O(1) batch fold: counters advance by n, the EWMA treats the
+        batch as n evenly spaced arrivals over the gap since the last one."""
+        if n <= 0:
+            return
+        now = time.monotonic() if now is None else now
+        self.num_requests += n
+        self.num_total += n
+        if sender is not None:
+            self.by_sender[sender] = self.by_sender.get(sender, 0) + n
+        if self._last_request_t > 0:
+            ia = (now - self._last_request_t) / n
             self.inter_arrival_ewma = (
                 ia
                 if self.inter_arrival_ewma == 0

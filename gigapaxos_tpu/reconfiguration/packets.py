@@ -56,6 +56,12 @@ NODE_CONFIG_RESPONSE = "node_config_response"
 # client <-> active replica
 APP_REQUEST = "app_request"                        # AppRequest / ReplicableClientRequest
 APP_RESPONSE = "app_response"
+# many client requests in one frame + one frame of responses back — the
+# client-edge RequestBatcher (RequestPacket.java:189-233 `batched[]`,
+# RequestBatcher.java:25-60).  Dedup is batch-granular: retransmissions
+# reuse the batch id and are absorbed/replayed as a unit.
+APP_REQUEST_BATCH = "app_request_batch"
+APP_RESPONSE_BATCH = "app_response_batch"
 ECHO_REQUEST = "echo_request"                      # ActiveReplica.handleEchoRequest:1126
 ECHO_REPLY = "echo_reply"
 
@@ -140,6 +146,15 @@ def app_request(
         "payload": b64e(payload),
         "rid": rid,
         "need_response": need_response,
+    }
+
+
+def app_request_batch(reqs, bid: int) -> dict:
+    """reqs: list of (name, rid, payload bytes)."""
+    return {
+        "type": APP_REQUEST_BATCH,
+        "bid": bid,
+        "reqs": [[n, r, b64e(p)] for n, r, p in reqs],
     }
 
 

@@ -18,6 +18,7 @@ CLI: ``python -m gigapaxos_tpu.testing.capacity [--groups N] [--load L]``.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from dataclasses import dataclass, field
@@ -85,11 +86,22 @@ def make_loopback_cluster(
     cfg = GigapaxosTpuConfig()
     cfg.paxos.max_groups = max_groups or max(64, n_groups)
     cfg.paxos.pipeline_ticks = True  # stage-overlap on the probe clusters
+    cfg.paxos.compact_outbox = True  # vectorized host loop (batch edge)
+    cfg.paxos.min_tick_interval_s = 0.004  # coalesce: amortize tick cost
     for i in range(n_actives):
         cfg.nodes.actives[f"AR{i}"] = ("127.0.0.1", 0)
     for i in range(n_rc):
         cfg.nodes.reconfigurators[f"RC{i}"] = ("127.0.0.1", 0)
-    cluster = InProcessCluster(cfg, app_factory)
+    from ..reconfiguration.demand import DemandProfile
+
+    cluster = InProcessCluster(
+        cfg, app_factory,
+        # sparse demand reports: at probe rates the reference's
+        # report-per-request cadence floods the RC plane (3 frames/req)
+        demand_profile_factory=lambda name: DemandProfile(
+            name, min_requests_before_report=64
+        ),
+    )
     client = ReconfigurableAppClient(cfg.nodes)
     for g in range(n_groups):
         resp = client.create(f"g{g}")
@@ -103,22 +115,42 @@ class CapacityProbe:
     ladder (TESTPaxosClient's runTestWorkload + capacity loop)."""
 
     def __init__(self, client: ReconfigurableAppClient, names: List[str],
-                 payload: bytes = b"noop"):
+                 payload: bytes = b"noop", batch: bool = False):
         self.client = client
         self.names = names
         self.payload = payload
+        # client-edge coalescing (RequestBatcher analog): many requests per
+        # frame instead of one — the round-3 capacity knee was frame cost
+        self.sender = client.batching() if batch else None
         # pre-resolve every name so measurement excludes actives lookups
         for n in names:
             self.client.request_actives(n)
 
+    #: latency is sampled 1-in-N so the probe harness itself doesn't tax
+    #: the measured system (the shared-core analog of the reference's
+    #: sampled response timing, TESTPaxosClient.java:59)
+    LAT_SAMPLE = 8
+
     def run_once(self, load: float, duration_s: float) -> ProbeResult:
         res = ProbeResult(load=load, sent=0, responded=0, errors=0,
                           duration_s=duration_s)
-        lock = threading.Lock()
+        # deque.append is atomic under the GIL: response accounting needs
+        # no lock on the hot path
+        ok_in = collections.deque()
+        ok_late = collections.deque()
+        errs = collections.deque()
+        lats = collections.deque()
         t_end = time.monotonic() + duration_s
         interval = 1.0 / load
         i = 0
         next_t = time.monotonic()
+
+        def cb_fast(p):
+            if p.get("ok"):
+                (ok_in if time.monotonic() <= t_end else ok_late).append(1)
+            else:
+                errs.append(1)
+
         while time.monotonic() < t_end:
             now = time.monotonic()
             if now < next_t:
@@ -127,31 +159,36 @@ class CapacityProbe:
             next_t += interval
             name = self.names[i % len(self.names)]
             i += 1
-            t0 = time.monotonic()
+            if i % self.LAT_SAMPLE == 0:
+                t0 = time.monotonic()
 
-            def cb(p, t0=t0):
-                now = time.monotonic()
-                with lock:
+                def cb(p, t0=t0):
                     if p.get("ok"):
-                        res.responded += 1
-                        if now <= t_end:
-                            res.responded_in_window += 1
-                        res.latencies_s.append(now - t0)
+                        now2 = time.monotonic()
+                        (ok_in if now2 <= t_end else ok_late).append(1)
+                        lats.append(now2 - t0)
                     else:
-                        res.errors += 1
-
+                        errs.append(1)
+            else:
+                cb = cb_fast
             try:
-                self.client.send_request(name, self.payload, cb)
+                if self.sender is not None:
+                    self.sender.submit(name, self.payload, cb)
+                else:
+                    self.client.send_request(name, self.payload, cb)
                 res.sent += 1
             except Exception:
                 res.errors += 1
         # drain window: late responses still count against offered load
         deadline = time.monotonic() + min(2.0, PROBE_MAX_LATENCY_S * 2)
         while time.monotonic() < deadline:
-            with lock:
-                if res.responded + res.errors >= res.sent:
-                    break
+            if len(ok_in) + len(ok_late) + len(errs) + res.errors >= res.sent:
+                break
             time.sleep(0.01)
+        res.responded_in_window = len(ok_in)
+        res.responded = len(ok_in) + len(ok_late)
+        res.errors += len(errs)
+        res.latencies_s = list(lats)
         return res
 
     def probe(self, init_load: float, duration_s: float = 2.0,
@@ -182,11 +219,23 @@ def main() -> None:
     ap.add_argument("--load", type=float, default=1000.0)
     ap.add_argument("--duration", type=float, default=2.0)
     ap.add_argument("--runs", type=int, default=10)
+    ap.add_argument("--batch", action="store_true",
+                    help="coalesce requests into batched frames")
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu — the ambient "
+                         "axon backend hangs the whole probe when the TPU "
+                         "tunnel is down)")
     args = ap.parse_args()
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     cluster, client = make_loopback_cluster(n_groups=args.groups)
     try:
-        probe = CapacityProbe(client, [f"g{i}" for i in range(args.groups)])
+        probe = CapacityProbe(client, [f"g{i}" for i in range(args.groups)],
+                              batch=args.batch)
         runs = probe.probe(args.load, args.duration, args.runs)
         for r in runs:
             print(json.dumps({
@@ -197,7 +246,8 @@ def main() -> None:
                 "passed": r.passed(r.load),
             }))
         print(json.dumps({
-            "metric": f"loopback_capacity_req_per_s_{args.groups}_groups",
+            "metric": f"loopback_capacity_req_per_s_{args.groups}_groups"
+                      + ("_batched" if args.batch else ""),
             "value": round(CapacityProbe.capacity(runs), 1),
             "unit": "req/s",
         }))

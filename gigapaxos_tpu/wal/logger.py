@@ -141,9 +141,16 @@ class PaxosLogger:
                 list(payloads),
             )
         alive = np.asarray(inbox.alive).tobytes()
+        kv_reg = None
+        up = getattr(m, "_kv_uploaded", None)
+        if up is not None:
+            # device app: descriptor uploads must replay in upload order
+            # (they are device-state writes, like the tick itself)
+            kv_reg = tuple(a.tobytes() for a in up)
+            m._kv_uploaded = None
         self.journal.append(
             pickle.dumps((OP_TICK, tick_num, placed_with_payloads, alive,
-                          bulk))
+                          bulk, kv_reg))
         )
         self._ticks_since_sync += 1
         if self._ticks_since_sync >= self.sync_every:
@@ -208,6 +215,19 @@ class PaxosLogger:
                 and (m._bulk_leftover.size or m._bulk_chunks)
                 else None
             ),
+            # device-app: staged-but-not-yet-uploaded descriptors + the
+            # placement watermark (uploads already on device replay from
+            # the journal's kv_reg records)
+            "kv_chunks": (
+                [tuple(a.tobytes() for a in c) for c in m._kv_chunks]
+                if getattr(m, "_device_app", False) else None
+            ),
+            "kv_watermark": (m._kv_watermark
+                             if getattr(m, "_device_app", False) else None),
+            # device-app managers snapshot the device arrays verbatim
+            # (dkv_* in the npz); the per-name app projection would be
+            # redundant — and lossy: key 0 is the KV empty-slot sentinel,
+            # so a row-granular restore cannot represent it
             "apps": [
                 {
                     name: m.apps[i].checkpoint(name)
@@ -215,7 +235,7 @@ class PaxosLogger:
                     + list(getattr(m, "_paused", {}))
                 }
                 for i in range(m.R)
-            ],
+            ] if not getattr(m, "_device_app", False) else None,
         }
 
     @staticmethod
@@ -233,6 +253,10 @@ class PaxosLogger:
         new_seq = m.tick_num
         path = self._snapshot_path(new_seq)
         state_np = {f: np.asarray(getattr(m.state, f)) for f in m.state._fields}
+        if getattr(m, "kv", None) is not None:
+            # device-app state snapshots alongside the consensus arrays
+            for f in m.kv._fields:
+                state_np["dkv_" + f] = np.asarray(getattr(m.kv, f))
         meta = self._meta(m)
         buf = io.BytesIO()
         np.savez_compressed(buf, **state_np)
@@ -307,6 +331,7 @@ def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
                 if tick_num < m.tick_num:
                     continue  # already inside the snapshot
                 bufs = new_buffers(m)
+                m._replay_kv_reg = rec[5] if len(rec) > 5 else None
                 bulk_placed = None
                 if bulk_rec is not None and bulk_replay is not None:
                     bulk_placed = bulk_replay(m, bufs, bulk_rec)
@@ -333,7 +358,10 @@ def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
                         )
                 alive = np.frombuffer(alive_b, dtype=bool)
                 m.state, out = tick_fn(m.state, build_inbox(bufs, alive))
-                if bulk_placed is not None:
+                proc = getattr(m, "_replay_process", None)
+                if proc is not None:
+                    proc(out, bulk_placed)
+                elif bulk_placed is not None:
                     m._process_outbox(out, None, bulk_placed)
                 else:
                     m._process_outbox(out)
@@ -388,6 +416,20 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
             m._ensure_bulk().restore(meta["bulk"])
         if meta.get("bulk_queue") is not None:
             m._bulk_leftover = np.asarray(meta["bulk_queue"], np.int64)
+        if getattr(m, "_device_app", False):
+            if any(k.startswith("dkv_") for k in arrs.files):
+                from ..models.device_kv import DeviceKVState
+
+                m.kv = DeviceKVState(**{
+                    f: jnp.asarray(arrs["dkv_" + f])
+                    for f in DeviceKVState._fields
+                })
+            if meta.get("kv_watermark") is not None:
+                m._kv_watermark = int(meta["kv_watermark"])
+            for c in meta.get("kv_chunks") or []:
+                m._kv_chunks.append(tuple(
+                    np.frombuffer(b, np.int32).copy() for b in c
+                ))
         for k, items in meta["seen"].items():
             od = collections.OrderedDict(items)
             m._seen[k] = od
@@ -408,9 +450,10 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
         )
         for row in m.rows._row_to_name:
             m._last_active[row] = m.tick_num
-        for i in range(m.R):
-            for name, blob in meta["apps"][i].items():
-                m.apps[i].restore(name, blob)
+        if meta.get("apps") is not None:
+            for i in range(m.R):
+                for name, blob in meta["apps"][i].items():
+                    m.apps[i].restore(name, blob)
         start_seq = snap_seq
 
     def make_record(m, rid, row, payload, stop, entry):
@@ -429,13 +472,47 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
         return TickInbox(jnp.asarray(bufs[0]), jnp.asarray(bufs[1]),
                          jnp.asarray(alive))
 
-    def tick_host(state, inbox):
-        # replay must evolve state EXACTLY as the live run did, so the
-        # exec budget (if the live run used the compact path) applies here
-        # too even though replay consumes the full outbox
-        budget = m._exec_budget if m._use_compact else 0
-        state, packed = paxos_tick_packed(state, inbox, -1, budget)
-        return state, unpack_outbox(packed, m.R, m.P, m.W, m.G)
+    if getattr(m, "_device_app", False):
+        # device-app replay: the same fused program as the live run —
+        # descriptor uploads in journal order, on-device execution,
+        # compact-path host processing
+        from ..models.device_kv import fused_compact
+        from ..ops.tick import unpack_compact
+
+        E, Lb, K = m._exec_budget, m._lag_budget, m._kv_reg_budget
+
+        def tick_host(state, inbox):
+            reg = getattr(m, "_replay_kv_reg", None)
+            arrs4 = [np.zeros(K, np.int32) for _ in range(4)]
+            if reg is not None:
+                for buf, dst in zip(reg, arrs4):
+                    a = np.frombuffer(buf, np.int32)
+                    dst[:len(a)] = a
+                r0 = np.frombuffer(reg[0], np.int32)
+                if len(r0):
+                    m._kv_watermark = max(m._kv_watermark, int(r0.max()))
+            state, m.kv, packed = fused_compact(
+                state, m.kv, inbox, *arrs4, -1, E, Lb
+            )
+            flat = np.asarray(packed)
+            co = unpack_compact(flat, m.R, m.G, E, Lb)
+            base = 3 + m.R * m.G + 4 * E + 2 * Lb
+            return state, (co, flat[base:base + E],
+                           flat[base + E:base + 2 * E])
+
+        def _proc(out, bulk_placed):
+            co, er, em = out
+            m._process_compact(co, m._placed, bulk_placed, er, em)
+
+        m._replay_process = _proc
+    else:
+        def tick_host(state, inbox):
+            # replay must evolve state EXACTLY as the live run did, so the
+            # exec budget (if the live run used the compact path) applies
+            # here too even though replay consumes the full outbox
+            budget = m._exec_budget if m._use_compact else 0
+            state, packed = paxos_tick_packed(state, inbox, -1, budget)
+            return state, unpack_outbox(packed, m.R, m.P, m.W, m.G)
 
     def bulk_replay(m, bufs, bulk_rec):
         rids_b, be_b, bp_b, br_b, stop_b, payloads = bulk_rec
@@ -460,6 +537,8 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
 
     replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
                     build_inbox, tick_host, bulk_replay=bulk_replay)
+    if hasattr(m, "_replay_process"):
+        del m._replay_process
     # reattach logging
     logger.attach(m)
     m.wal = logger

@@ -150,7 +150,12 @@ def test_checkpoint_restore_roundtrip():
     kv = register_requests(kv, [1, 2], [OP_PUT, OP_PUT], [3, 6], [30, 60])
     req, cnt = make_exec([(0, 1, [1, 2])])
     kv, _, _ = kv_apply(kv, req, cnt)
-    app = DeviceKVApp(kv, replica=0, row_of=lambda name: 1)
+    class Holder:  # any object with a mutable .kv (the manager, in prod)
+        pass
+
+    owner = Holder()
+    owner.kv = kv
+    app = DeviceKVApp(owner, replica=0, row_of=lambda name: 1)
     blob = app.checkpoint("svc")
     assert blob
     # wipe and restore
@@ -160,5 +165,11 @@ def test_checkpoint_restore_roundtrip():
     app.restore("svc", blob)
     assert int(app.kv.val[0, 1, 3 & (S - 1)]) == 30
     assert int(app.kv.val[0, 1, 6 & (S - 1)]) == 60
-    with pytest.raises(NotImplementedError):
-        app.execute("svc", b"x", 1)
+    # the scalar fallback applies descriptors with kv_apply's semantics
+    from gigapaxos_tpu.models.device_kv import pack_desc
+
+    resp = app.execute("svc", pack_desc(OP_PUT, 3, 99), 7)
+    assert resp == (99).to_bytes(4, "little")
+    assert int(app.kv.val[0, 1, 3 & (S - 1)]) == 99
+    # non-descriptor payloads are inert (control-plane noops)
+    assert app.execute("svc", b"x", 8) == b""

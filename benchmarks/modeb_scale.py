@@ -1,0 +1,146 @@
+"""Mode B at scale: anti-entropy cost, frame-build O(dirty), and
+mass-laggard convergence at G=10k across a real 3-node socket cluster.
+
+The round-2/3 evidence stopped at G=248; this runs the measurements the
+judge asked for (VERDICT round 3 item 5): steady-state frame bytes/tick
+with a small dirty set out of 10k groups, and a killed node converging
+after missing one commit on EVERY group.
+
+Usage: python benchmarks/modeb_scale.py [--groups 10240] [--platform cpu]
+Prints JSON lines; commit the output into results_r4.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--groups", type=int, default=10240)
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import numpy as np
+
+    from gigapaxos_tpu.config import GigapaxosTpuConfig
+    from gigapaxos_tpu.models.replicable import NoopApp
+    from gigapaxos_tpu.modeb import ModeBNode
+    from gigapaxos_tpu.net.messenger import Messenger, NodeMap
+
+    G = args.groups
+    IDS = ["N0", "N1", "N2"]
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = G
+    cfg.paxos.deactivation_ticks = 0
+
+    nodemap = NodeMap()
+    msgs, nodes = {}, {}
+    for nid in IDS:
+        m = Messenger(nid, ("127.0.0.1", 0), nodemap)
+        nodemap.add(nid, "127.0.0.1", m.port)
+        msgs[nid] = m
+    for nid in IDS:
+        nodes[nid] = ModeBNode(cfg, IDS, nid, NoopApp(), msgs[nid],
+                               anti_entropy_every=256)
+
+    t0 = time.perf_counter()
+    names = [f"g{i}" for i in range(G)]
+    for n in nodes.values():
+        n.create_groups_bulk(names, [0, 1, 2])
+    create_s = time.perf_counter() - t0
+    print(json.dumps({"metric": f"modeb_bulk_create_{G}_groups_3_nodes",
+                      "value": round(create_s, 2), "unit": "s"}))
+
+    def ticks(k, only=None):
+        for _ in range(k):
+            for nid, n in nodes.items():
+                if only is None or nid in only:
+                    n.tick()
+
+    def commit_wave(width, tag):
+        done = []
+        for i in range(width):
+            nodes["N0"].propose(f"g{i}", f"{tag}{i}".encode(),
+                                lambda rid, resp: done.append(resp))
+        t = 0
+        while len(done) < width and t < 600:
+            ticks(1)
+            t += 1
+        return len(done), t
+
+    # warm the kernels + elect coordinators for a small working set
+    got, t = commit_wave(64, "w")
+    assert got == 64, got
+
+    # --- steady-state anti-entropy: tiny dirty set out of G rows ---
+    for n in nodes.values():
+        n.stats["frame_bytes_sent"] = 0
+    base_ticks = {nid: n.tick_num for nid, n in nodes.items()}
+    got, t = commit_wave(64, "x")
+    total_bytes = sum(n.stats["frame_bytes_sent"] for n in nodes.values())
+    total_ticks = sum(n.tick_num - base_ticks[nid]
+                      for nid, n in nodes.items())
+    per_tick = total_bytes / max(total_ticks, 1)
+    print(json.dumps({
+        "metric": f"modeb_frame_bytes_per_tick_{G}_groups_64_dirty",
+        "value": round(per_tick, 1), "unit": "B/tick",
+        "detail": {"commits": got, "ticks": total_ticks,
+                   "note": "O(dirty): 64 active rows of " + str(G)},
+    }))
+
+    # --- mass laggard: N2 misses one commit on EVERY group ---
+    quiet = {"N0", "N1"}
+    done = []
+    for i in range(G):
+        nodes["N0"].propose(f"g{i}", b"m", lambda rid, resp: done.append(resp))
+    t = 0
+    while len(done) < G and t < 3000:
+        ticks(1, only=quiet)
+        t += 1
+    assert len(done) == G, f"majority committed only {len(done)}/{G}"
+    for n in nodes.values():
+        n.stats["frame_bytes_sent"] = 0
+    # N2 rejoins: converge = its exec watermark matches N0's everywhere
+    n2 = nodes["N2"]
+    n0 = nodes["N0"]
+    t0 = time.perf_counter()
+    t = 0
+    n2.request_sync()
+    while t < 4000:
+        ticks(1)
+        t += 1
+        if t % 64 == 0:
+            a = np.asarray(n2.state.exec_slot[n2.r])
+            b = np.asarray(n0.state.exec_slot[n0.r])
+            if (a >= b).all():
+                break
+    conv_s = time.perf_counter() - t0
+    a = np.asarray(n2.state.exec_slot[n2.r])
+    b = np.asarray(n0.state.exec_slot[n0.r])
+    lag_left = int((b - a).clip(0).sum())
+    rx_bytes = sum(n.stats["frame_bytes_sent"] for n in nodes.values())
+    print(json.dumps({
+        "metric": f"modeb_mass_laggard_convergence_{G}_groups",
+        "value": round(conv_s, 1), "unit": "s",
+        "detail": {"ticks": t, "residual_lag_slots": lag_left,
+                   "frame_bytes_total": rx_bytes},
+    }))
+
+    for m in msgs.values():
+        m.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -10,9 +10,10 @@ snapshot + in-order replay through the same jitted kernel, followed by
 from __future__ import annotations
 
 import io
-import pickle
 
 import numpy as np
+
+from ..wal import records
 
 from ..modeb.logger import ModeBLogger, replay_node_journals
 
@@ -66,7 +67,7 @@ def recover_chain_modeb(cfg, member_ids, node_id, app, log_dir: str,
     meta = npz_blob = None
     if snap_seq is not None:
         with open(logger._snapshot_path(snap_seq), "rb") as f:
-            meta, npz_blob = pickle.loads(f.read())
+            meta, npz_blob = records.loads(f.read())
     # a runtime-expanded universe supersedes the boot topology (see
     # modeb/logger.recover_modeb); journaled OP_EXPANDs extend it further
     members = list(meta.get("members", member_ids)) if meta else member_ids

@@ -169,6 +169,18 @@ class ReconfigurableAppClient:
     # ------------------------------------------------------- name management
     def create(self, name: str, initial_state: bytes = b"",
                timeout: float = 15.0) -> dict:
+        """Create a service name.
+
+        Caveat on retried creates: if an attempt times out and a retry
+        answers "exists", the result maps to ok=True with
+        ``note="created_by_earlier_attempt"`` — the usual cause is our own
+        first attempt committing late.  It is however AMBIGUOUS: another
+        client may have created the name first, in which case OUR
+        initial_state was silently not applied.  Callers that care must
+        disambiguate (read the state back, or encode a creator token in
+        initial_state); the reference client has the same hole
+        (DUPLICATE_ERROR tolerance, ReconfigurableAppClientAsync.java:35).
+        """
         def on_reply(resp: dict, retried: bool) -> dict:
             if (not resp.get("ok") and resp.get("error") == "exists"
                     and retried):

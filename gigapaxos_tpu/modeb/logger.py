@@ -24,11 +24,12 @@ from __future__ import annotations
 import glob
 import io
 import os
-import pickle
 
 import numpy as np
 
-from ..wal.logger import OP_CREATE, OP_REMOVE, OP_TICK, PaxosLogger
+from ..wal import records
+from ..wal.logger import (OP_CREATE, OP_PAUSE, OP_REMOVE, OP_TICK,
+                          OP_UNPAUSE, PaxosLogger)
 from .kernel import unpack_node_tick
 
 OP_FRAME = 6
@@ -58,7 +59,7 @@ def replay_node_journals(node, log_dir, start_seq, stage, new_buffers,
         if seq < start_seq:
             continue
         for raw in read_journal(path):
-            rec = pickle.loads(raw)
+            rec = records.loads(raw)
             op = rec[0]
             if op == OP_CREATE:
                 _, name, members, epoch = rec
@@ -68,6 +69,10 @@ def replay_node_journals(node, log_dir, start_seq, stage, new_buffers,
                 node.expand_universe(rec[1], _log=False)
             elif op == OP_REMOVE:
                 node.remove_group(rec[1])
+            elif op == OP_PAUSE:
+                node._do_pause([n for n in rec[1] if n in node.rows])
+            elif op == OP_UNPAUSE:
+                node._unpause(rec[1])
             elif op == OP_FRAME:
                 try:
                     stage(rec[1])
@@ -120,18 +125,18 @@ class ModeBLogger(PaxosLogger):
         """Journal a replica-universe expansion (node addition): replay
         must re-grow the state arrays before any later record that assumes
         the larger R."""
-        self.journal.append(pickle.dumps((OP_EXPAND, list(new_ids))))
+        self.journal.append(records.dumps((OP_EXPAND, list(new_ids))))
         self.journal.sync()
 
     def log_frame(self, payload: bytes) -> None:
         """Journal an applied replica frame (before mirror mutation; rides
         the next tick's group commit for fsync)."""
-        self.journal.append(pickle.dumps((OP_FRAME, payload)))
+        self.journal.append(records.dumps((OP_FRAME, payload)))
 
     def log_ckpt(self, gid: int, packet: dict) -> None:
         """Journal an adopted checkpoint transfer — it mutates own-row state
         outside the deterministic tick, so replay must re-apply it."""
-        self.journal.append(pickle.dumps((OP_CKPT, gid, dict(packet))))
+        self.journal.append(records.dumps((OP_CKPT, gid, dict(packet))))
         self.journal.sync()
 
     def log_inbox(self, tick_num: int, inbox) -> None:
@@ -150,7 +155,7 @@ class ModeBLogger(PaxosLogger):
                 placed.append((row, entries))
         alive = np.asarray(inbox.alive).tobytes()
         self.journal.append(
-            pickle.dumps((OP_TICK, tick_num, placed, alive))
+            records.dumps((OP_TICK, tick_num, placed, alive))
         )
         self._ticks_since_sync += 1
         if self._ticks_since_sync >= self.sync_every:
@@ -177,12 +182,19 @@ class ModeBLogger(PaxosLogger):
             "queues": {row: list(q) for row, q in m._queues.items() if q},
             "coord_view": m._coord_view.tobytes(),
             "frame_applied": dict(m._frame_applied_tick),
-            "app": {name: m.app.checkpoint(name) for name in m.rows.names()},
+            # paused names keep app state; the snapshot must carry both
+            # the spilled records and their app projections (the journal
+            # holding their OP_CREATE gets GC'd)
+            "paused": self._paused_snapshot(m),
+            "app": {
+                name: m.app.checkpoint(name)
+                for name in list(m.rows.names()) + list(m._paused)
+            },
         }
 
 
 def recover_modeb(cfg, member_ids, node_id, app, log_dir: str,
-                  native: bool = True):
+                  native: bool = True, spill_ns=None):
     """Rebuild a ModeBNode from its own disk; attach a messenger and call
     ``request_sync()`` afterwards to rejoin the replica set."""
     import collections
@@ -199,12 +211,16 @@ def recover_modeb(cfg, member_ids, node_id, app, log_dir: str,
     meta = npz_blob = None
     if snap_seq is not None:
         with open(logger._snapshot_path(snap_seq), "rb") as f:
-            meta, npz_blob = pickle.loads(f.read())
+            meta, npz_blob = records.loads(f.read())
     # the universe may have been expanded at runtime (node additions): the
     # snapshot's member list supersedes the boot topology's, and journaled
     # OP_EXPAND records extend it further during replay
     members = list(meta.get("members", member_ids)) if meta else member_ids
-    node = ModeBNode(cfg, members, node_id, app)  # no messenger, no wal
+    node = ModeBNode(cfg, members, node_id, app,
+                     spill_ns=spill_ns)  # no messenger, no wal
+    # stale pre-crash spill files must never pre-populate the pause store
+    # (snapshot + journal are the authority for row allocation)
+    node._paused.clear()
     start_seq = 0
     if snap_seq is not None:
         arrs = np.load(io.BytesIO(npz_blob))
@@ -236,6 +252,8 @@ def recover_modeb(cfg, member_ids, node_id, app, log_dir: str,
             meta["coord_view"], dtype=np.int32
         ).copy()
         node._frame_applied_tick = dict(meta["frame_applied"])
+        node._paused.update(meta.get("paused", {}))
+        node._paused_gids = {wire.gid_of(n): n for n in node._paused}
         for name, blob in meta["app"].items():
             node.app.restore(name, blob)
         start_seq = snap_seq

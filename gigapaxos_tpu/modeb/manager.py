@@ -88,7 +88,11 @@ class ModeBNode(ModeBCommon):
         messenger: Optional[Messenger] = None,
         wal=None,
         anti_entropy_every: int = 64,
+        spill_ns: Optional[str] = None,
     ):
+        """``spill_ns`` namespaces this node's disk spill store — several
+        planes (AR + RC) of one process share a cfg and must never adopt
+        or clear each other's cold files."""
         self.cfg = cfg
         self.members = list(member_ids)
         self.node_id = node_id
@@ -118,6 +122,28 @@ class ModeBNode(ModeBCommon):
         )
         self._seen_cap = 8 * self.W
         self._stopped_rows: set = set()
+        # ---- pause/spill (per-node deactivation, PaxosManager.java:2284;
+        # pause tables SQLPaxosLogger.java:4044-4048).  A node pauses its
+        # own locally-quiescent groups independently; spilled records
+        # demand-page to disk so the per-process group population can
+        # exceed the preallocated device rows.  Mirror rows are soft state
+        # and simply re-fill from anti-entropy after unpause.
+        import os as _os
+
+        from ..utils.diskmap import DiskMap
+
+        self._paused = DiskMap(
+            _os.path.join(cfg.paxos.spill_dir,
+                          spill_ns or f"mb_{node_id}")
+            if cfg.paxos.spill_dir else None,
+            cfg.paxos.spill_cache,
+        )
+        # the spill dir is scratch — snapshot+journal are the only
+        # authority.  Stale pre-crash files must never resurrect consensus
+        # state on a fresh boot (recovery repopulates from its snapshot).
+        self._paused.clear()
+        self._paused_gids: Dict[int, str] = {}
+        self._row_last_active = np.zeros(self.G, np.int64)
         self._coord_view = np.full(self.G, -1, np.int32)
         self._dirty = np.zeros(self.G, bool)
         self._occupied = np.zeros(self.G, bool)  # live rows (frame targets)
@@ -190,10 +216,13 @@ class ModeBNode(ModeBCommon):
         plane's StartEpoch does exactly that); stragglers self-heal via
         whois when the first frame for an unknown gid arrives."""
         with self.lock:
-            if name in self.rows:
+            if name in self.rows or name in self._paused:
                 return False
             if self.rows.full():
-                return False
+                # demand-page: evict the coldest quiescent group so the
+                # per-process population can exceed the device rows
+                if not self.pause_idle(limit=1, ignore_idle=True):
+                    return False
             row = self.rows.alloc(name)
             mask = np.zeros((1, self.R), bool)
             for mm in members:
@@ -212,8 +241,52 @@ class ModeBNode(ModeBCommon):
                 self.wal.log_create(name, list(members), epoch)
             return True
 
+    def create_groups_bulk(self, names: List[str], members: List[int],
+                           epoch: int = 0) -> int:
+        """Batched create: one device call for the whole batch (the
+        BatchedCreateServiceName shape at the data plane).  Returns how
+        many were created; names already present / beyond capacity are
+        skipped (capacity overflow spills via the single-create path)."""
+        with self.lock:
+            fresh = list(dict.fromkeys(  # order-preserving dedup
+                n for n in names
+                if n not in self.rows and n not in self._paused
+            ))
+            take = fresh[:len(self.rows._free)]
+            rest = fresh[len(take):]
+            if take:
+                rows = np.array([self.rows.alloc(n) for n in take], np.int32)
+                mask = np.zeros((len(take), self.R), bool)
+                mask[:, members] = True
+                self.state = st.create_groups(
+                    self.state, rows, mask,
+                    np.full(len(take), epoch, np.int32),
+                )
+                for n, row in zip(take, rows):
+                    gid = wire.gid_of(n)
+                    self._gid_row[gid] = int(row)
+                    self._row_meta[int(row)] = (n, list(members), epoch)
+                    self._stopped_rows.discard(int(row))
+                    self._row_last_active[row] = self.tick_num
+                self._dirty[rows] = True
+                self._occupied[rows] = True
+                if self.wal is not None:
+                    # one fsync for the whole batch, not one per name
+                    self.wal.log_creates(take, list(members), epoch)
+            made = len(take)
+        for n in rest:  # overflow: the spilling single-create path
+            if self.create_group(n, list(members), epoch):
+                made += 1
+        return made
+
     def remove_group(self, name: str, _log: bool = True) -> bool:
         with self.lock:
+            if name in self._paused:
+                del self._paused[name]
+                self._paused_gids.pop(wire.gid_of(name), None)
+                if _log and self.wal is not None:
+                    self.wal.log_remove(name)
+                return True
             row = self.rows.row(name)
             if row is None:
                 return False
@@ -243,6 +316,119 @@ class ModeBNode(ModeBCommon):
                 self.wal.log_remove(name)
             return True
 
+    # ------------------------------------------------------------ pause/spill
+    def pause_idle(self, limit: int = 64, ignore_idle: bool = False) -> int:
+        """Spill locally-quiescent idle groups (Deactivator analog).  Must
+        hold the lock.  Safety: a row may only leave the device when no
+        own-row fact could still matter — everything assigned is executed,
+        no accepted pvalue sits above the execution watermark, and no
+        prepare is in flight; peers' mirror rows of us keep serving reads
+        of the past, and our coordinator ballot survives in the spilled
+        record."""
+        idle_after = 0 if ignore_idle else self.cfg.paxos.deactivation_ticks
+        if not ignore_idle and idle_after <= 0:
+            return 0
+        self.drain_pipeline()
+        r = self.r
+        exec_s = np.asarray(self.state.exec_slot[r])
+        next_s = np.asarray(self.state.next_slot[r])
+        acc_top = np.asarray(self.state.acc_slot[r]).max(axis=0)  # [G]
+        prop_any = np.asarray(self.state.prop_valid[r]).any(axis=0)
+        preparing = np.asarray(self.state.coord_preparing[r])
+        # responded records are retransmission-dedup memory, not live work
+        busy_rows = {rec.row for rec in self.outstanding.values()
+                     if not rec.responded}
+        cands = np.nonzero(
+            self._occupied
+            & (self.tick_num - self._row_last_active >= idle_after)
+            # own assignments drained (non-coordinators carry next_slot 0)
+            & (exec_s >= next_s) & (acc_top < exec_s)
+            & ~prop_any & ~preparing
+        )[0]
+        # coldest first so eviction keeps the working set hot
+        cands = sorted(cands, key=lambda rw: self._row_last_active[rw])
+        names = []
+        for row in cands:
+            row = int(row)
+            if len(names) >= limit:
+                break
+            if (self._queues.get(row) or row in busy_rows
+                    or row in self._tainted_rows):
+                continue
+            name = self.rows.name(row)
+            if name is not None:
+                names.append(name)
+        if names:
+            self._do_pause(names)
+            if self.wal is not None:
+                self.wal.log_pause(names)
+        return len(names)
+
+    def _do_pause(self, names) -> None:
+        """Spill exactly ``names`` (also the WAL replay entry point — must
+        mirror the live run's choice so row allocation stays in lockstep)."""
+        rows_to_free = []
+        for name in names:
+            row = self.rows.row(name)
+            hri = st.extract_hri(self.state, row)
+            hri["stopped"] = row in self._stopped_rows
+            self._paused[name] = {"hri": hri,
+                                  "meta": self._row_meta[row]}
+            gid = wire.gid_of(name)
+            self._paused_gids[gid] = name
+            self._gid_row.pop(gid, None)
+            rows_to_free.append(row)
+        self.state = st.free_groups(self.state,
+                                    np.array(rows_to_free, np.int32))
+        for name, row in zip(names, rows_to_free):
+            self.rows.free(name)
+            self._row_meta.pop(row, None)
+            self._stopped_rows.discard(row)
+            self._queues.pop(row, None)
+            self._occupied[row] = False
+            self._dirty[row] = False
+            # staged mirror frames resolved their row indices at arrival:
+            # a group recreated into this row must not inherit stale facts
+            self._purge_staged_row(row)
+        self.stats["paused"] += len(names)
+
+    def _unpause(self, name: str):
+        """Re-materialize a spilled group (getInstance -> unpause,
+        PaxosManager.java:2370-2412).  Own-row scalars restore from the
+        spilled record; peer mirrors start empty and refill from frames /
+        anti-entropy.  Returns the row, or None (not paused / no room)."""
+        rec = self._paused.get(name)
+        if rec is None:
+            return None
+        if self.rows.full():
+            if not self.pause_idle(limit=1, ignore_idle=True):
+                return None  # every row is hot — genuinely full
+        row = self.rows.alloc(name)
+        hri = rec["hri"]
+        mask = np.asarray(hri["member"]).reshape(1, -1)
+        self.state = st.create_groups(
+            self.state, np.array([row], np.int32), mask,
+            np.array([hri["epoch"]], np.int32),
+        )
+        self.state = st.hot_restore(self.state, row, hri)
+        gid = wire.gid_of(name)
+        del self._paused[name]
+        self._paused_gids.pop(gid, None)
+        self._gid_row[gid] = row
+        self._row_meta[row] = tuple(rec["meta"])
+        if hri.get("stopped"):
+            self._stopped_rows.add(row)
+        self._occupied[row] = True
+        self._dirty[row] = True  # announce our restored row to peers
+        self._row_last_active[row] = self.tick_num
+        self.stats["unpaused"] += 1
+        if self.wal is not None:
+            self.wal.log_unpause(name)
+        return row
+
+    def paused_count(self) -> int:
+        return len(self._paused)
+
     def _pre_expand(self) -> None:
         self.drain_pipeline()  # pending outbox shapes change with R
 
@@ -255,7 +441,10 @@ class ModeBNode(ModeBCommon):
 
     def is_stopped(self, name: str) -> bool:
         row = self.rows.row(name)
-        return row is not None and row in self._stopped_rows
+        if row is None:
+            rec = self._paused.get(name)
+            return bool(rec and rec["hri"].get("stopped"))
+        return row in self._stopped_rows
 
     def group_members(self, name: str):
         """Replica-slot members of a group (``getReplicaGroup`` analog,
@@ -263,7 +452,8 @@ class ModeBNode(ModeBCommon):
         with self.lock:
             row = self.rows.row(name)
             if row is None:
-                return None
+                rec = self._paused.get(name)
+                return list(rec["meta"][1]) if rec is not None else None
             meta = self._row_meta.get(row)
             return list(meta[1]) if meta is not None else None
 
@@ -271,7 +461,8 @@ class ModeBNode(ModeBCommon):
         with self.lock:
             row = self.rows.row(name)
             if row is None:
-                return None
+                rec = self._paused.get(name)
+                return rec["meta"][2] if rec is not None else None
             meta = self._row_meta.get(row)
             return meta[2] if meta is not None else None
 
@@ -297,6 +488,8 @@ class ModeBNode(ModeBCommon):
             # occupant's stopped flag is discarded
             with self.lock:
                 row = self.rows.row(name)
+                if row is None and name in self._paused:
+                    row = self._unpause(name)  # demand-page back in
                 if row is None or row in self._stopped_rows:
                     if callback is not None:
                         self._held_callbacks.append((callback, -1, None))
@@ -316,6 +509,8 @@ class ModeBNode(ModeBCommon):
             except IndexError:
                 return
             row = self.rows.row(name)
+            if row is None and name in self._paused:
+                row = self._unpause(name)
             if row is None or row in self._stopped_rows:
                 # the group vanished or stopped between stage and drain
                 if callback is not None:
@@ -367,6 +562,8 @@ class ModeBNode(ModeBCommon):
         stop = bool(p.get("stop"))
         with self.lock:
             row = self._gid_row.get(gid)
+            if row is None and gid in self._paused_gids:
+                row = self._unpause(self._paused_gids[gid])
             if row is None:
                 self._whois(gid, sender)
                 return
@@ -423,10 +620,16 @@ class ModeBNode(ModeBCommon):
                 )
                 self._dirty |= changed
                 self._complete_tick(out, placed)
+            if (self.cfg.paxos.deactivation_ticks > 0
+                    and self.tick_num % 256 == 0 and len(self.rows) > 0):
+                self.pause_idle()
             frames = self._build_frames()
             if self.wal is not None:
                 self.wal.maybe_checkpoint()
         if frames and self.m is not None:
+            self.stats["frame_bytes_sent"] += sum(map(len, frames)) * (
+                len(self.members) - 1
+            )
             for i, peer in enumerate(self.members):
                 if i != self.r:
                     try:
@@ -482,6 +685,7 @@ class ModeBNode(ModeBCommon):
                 p += 1
             if take:
                 placed.append((row, take))
+                self._row_last_active[row] = self.tick_num
         self._placed = placed
         # fresh copies for the jit (the staging buffers are mutated next
         # build; zero-copy dispatch aliasing them would race the async step)
@@ -530,6 +734,7 @@ class ModeBNode(ModeBCommon):
 
     def _execute_one(self, row: int, name: str, rid: int, slot: int,
                      is_stop: bool) -> None:
+        self._row_last_active[row] = self.tick_num
         if is_stop and row not in self._stopped_rows:
             self._stopped_rows.add(row)
             q = self._queues.pop(row, None)
@@ -664,9 +869,13 @@ class ModeBNode(ModeBCommon):
         rows = np.full(n, -1, np.int64)
         unknown = []
         for i in range(n):
-            row = self._gid_row.get(int(frame.gids[i]))
+            gid = int(frame.gids[i])
+            row = self._gid_row.get(gid)
+            if row is None and gid in self._paused_gids:
+                # peer traffic for a spilled group demand-pages it back
+                row = self._unpause(self._paused_gids[gid])
             if row is None:
-                unknown.append(int(frame.gids[i]))
+                unknown.append(gid)
             else:
                 rows[i] = row
         if unknown and sender != "?":
@@ -676,6 +885,7 @@ class ModeBNode(ModeBCommon):
         if not sel.any():
             return
         keep = np.nonzero(sel)[0]
+        self._row_last_active[rows[sel]] = self.tick_num  # peer activity
         self._pending_mirror.append((sr, rows[sel], keep, frame))
         self.stats["frames_staged"] += 1
 
@@ -721,6 +931,8 @@ class ModeBNode(ModeBCommon):
         gid = int(p["gid"])
         with self.lock:
             row = self._gid_row.get(gid)
+            if row is None and gid in self._paused_gids:
+                row = self._unpause(self._paused_gids[gid])
             if row is None:
                 return
             name, members, epoch = self._row_meta[row]

@@ -52,6 +52,11 @@ def gather_planes(arr, idx):
     ``arr``: ``[..., Wp, G]``; ``idx``: ``[..., J, G]`` int32 in [0, Wp).
     Returns ``out[..., j, g] = arr[..., idx[..., j, g], g]``.
 
+    PRECONDITION: every idx value must be in [0, Wp) — callers pass mod-W /
+    clamped ring indices.  Out-of-range indices are UNDEFINED and the two
+    implementations genuinely diverge there (the pallas kernel yields 0,
+    this one-hot fallback yields plane 0's value); never rely on either.
+
     This is the TPU-friendly form of ``take_along_axis`` for ring windows:
     the G (lane) axis stays minor and fully parallel, and the Wp-way select
     unrolls into Wp fused ``where`` ops instead of a hardware gather along a

@@ -155,6 +155,13 @@ def concat_replica_slots(state, fresh):
         if a.ndim >= 2 and a.shape[0] == R:
             merged[f] = jnp.concatenate([a, b], axis=0)
         else:
+            # only 1-D per-group config may skip concatenation: a future
+            # 2-D [G, *] field whose leading dim happened to equal R would
+            # otherwise be concatenated on the WRONG axis silently
+            assert a.ndim == 1, (
+                f"{f}: shape {a.shape} is neither replica-led nor 1-D "
+                "per-group config — extend concat_replica_slots explicitly"
+            )
             merged[f] = a
     return type(state)(**merged)
 

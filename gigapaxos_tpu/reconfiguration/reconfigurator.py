@@ -205,6 +205,30 @@ class RCMigrateTask(ProtocolTask):
         return [], True
 
 
+class UniverseGossipTask(ProtocolTask):
+    """Low-rate re-broadcast of the committed replica universe: closes the
+    window where an active partitioned across an add_active only converges
+    at the NEXT add (nc_universe_apply is idempotent, so over-delivery is
+    free)."""
+
+    period_s = 5.0
+    max_restarts = 1 << 30
+
+    def __init__(self, rc):
+        self.rc = rc
+
+    @property
+    def key(self) -> str:
+        return f"UniverseGossip:{self.rc.node_id}"
+
+    def start(self):
+        self.rc._broadcast_universe()
+        return []
+
+    def handle(self, event):
+        return [], False
+
+
 class NodeDrainTask(ProtocolTask):
     """Retrying drain of a removed active: sweeps until no record this RC
     can see still lists the node (names that were mid-reconfiguration at
@@ -788,26 +812,37 @@ class Reconfigurator:
             # this RC can resolve, so a server that missed an earlier add
             # catches up on both the slots and the routing from the next)
             universe = (record or {}).get("universe") or pool
-            addrs = {}
-            for nid in universe:
-                a_ = self.m.nodemap(nid)
-                if a_ is not None:
-                    addrs[nid] = list(a_)
-            if addr:
-                addrs[node] = list(addr)
-            for a in pool:
-                try:
-                    self.m.send(a, {
-                        "type": "nc_universe_apply",
-                        "universe": list(universe), "addrs": addrs,
-                    })
-                except Exception:  # a down active learns from its WAL/boot
-                    pass
+            self._universe_committed = list(universe)
+            self._broadcast_universe()
+            # keep re-broadcasting at a low rate: an active partitioned
+            # across THIS add would otherwise only converge when a future
+            # add triggers the next broadcast (advisor, round 3)
+            self.executor.schedule(UniverseGossipTask(self))
             return
         # removal: drain the node with a retrying task, not a one-shot pass —
         # names mid-reconfiguration (or whose primary is down) at commit time
         # must still be migrated once they quiesce
         self.executor.schedule(NodeDrainTask(self, node))
+
+    def _broadcast_universe(self) -> None:
+        """Send the committed replica-slot order + resolvable addresses to
+        every pool member (idempotent; see _apply_node_config)."""
+        universe = getattr(self, "_universe_committed", None)
+        if not universe:
+            return
+        addrs = {}
+        for nid in universe:
+            a_ = self.m.nodemap(nid)
+            if a_ is not None:
+                addrs[nid] = list(a_)
+        for a in self.actives_pool:
+            try:
+                self.m.send(a, {
+                    "type": "nc_universe_apply",
+                    "universe": list(universe), "addrs": addrs,
+                })
+            except Exception:  # a down active learns from its WAL/boot
+                pass
 
     def _drain_node_once(self, node: str) -> int:
         """One drain sweep: migrate off ``node`` every name this RC should

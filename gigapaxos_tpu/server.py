@@ -133,6 +133,7 @@ class ModeBServer:
                 node, recovered = self._make_node(
                     universe_ids, self.app,
                     os.path.join(log_dir, f"{node_id}-ar") if log_dir else None,
+                    spill_ns=f"{node_id}-ar",
                 )
             else:
                 raise ValueError(f"unknown coordinator {coordinator!r}")
@@ -176,6 +177,7 @@ class ModeBServer:
             rc_node, recovered = self._make_node(
                 rc_ids, db,
                 os.path.join(log_dir, f"{node_id}-rc") if log_dir else None,
+                spill_ns=f"{node_id}-rc",
             )
             self.rdb = ModeBRepliconfigurableDB(rc_node, rc_ids, k=rc_group_size)
             fd = None
@@ -254,7 +256,7 @@ class ModeBServer:
         node.on_work = driver.kick
         return driver.start()
 
-    def _make_node(self, member_ids, app, wal_dir):
+    def _make_node(self, member_ids, app, wal_dir, spill_ns=None):
         """Build (or WAL-recover) one plane's ModeBNode, messenger-less —
         the caller attaches the messenger after the control-plane endpoint
         claims its handlers (3-pass recovery before live traffic,
@@ -262,14 +264,15 @@ class ModeBServer:
         if wal_dir and os.path.isdir(wal_dir) and os.listdir(wal_dir):
             node = recover_modeb(
                 self.cfg, member_ids, self.node_id, app, wal_dir,
-                native=self.cfg.native_journal,
+                native=self.cfg.native_journal, spill_ns=spill_ns,
             )
             return node, True
         wal = None
         if wal_dir:
             wal = ModeBLogger(wal_dir, native=self.cfg.native_journal)
         node = ModeBNode(
-            self.cfg, member_ids, self.node_id, app, messenger=None, wal=wal
+            self.cfg, member_ids, self.node_id, app, messenger=None,
+            wal=wal, spill_ns=spill_ns,
         )
         return node, False
 

@@ -7,7 +7,8 @@ on access.  The dense framework uses it for the pause/spill store — a node
 can hold orders of magnitude more *paused* groups than device rows or host
 RAM would allow (``PaxosManager.java:2284-2365`` pause analog).
 
-Layout: one pickle file per key under ``dir_path`` (keys are hashed to
+Layout: one record file per key under ``dir_path`` (typed binary codec,
+wal/records.py — nothing executable on any replay path; keys hash to
 filenames; collisions resolved by storing the key alongside the value).
 Thread-safe via one lock — callers are host control-plane paths, not the
 device hot loop.
@@ -18,11 +19,11 @@ from __future__ import annotations
 import collections
 import hashlib
 import os
-import pickle
+
 import threading
 from typing import Any, Iterator, Optional
 
-_PROTO = pickle.HIGHEST_PROTOCOL
+from ..wal import records
 
 
 class DiskMap:
@@ -41,10 +42,10 @@ class DiskMap:
         self._lock = threading.Lock()
         if dir_path is not None:
             for fn in os.listdir(dir_path):
-                if fn.endswith(".pkl"):
+                if fn.endswith(".rec"):
                     try:
                         with open(os.path.join(dir_path, fn), "rb") as f:
-                            key, _ = pickle.load(f)
+                            key, _ = records.loads(f.read())
                         self._cold.add(key)
                     except Exception:
                         continue  # torn file: treated as absent
@@ -52,19 +53,19 @@ class DiskMap:
     # ------------------------------------------------------------- disk I/O
     def _path(self, key: str) -> str:
         h = hashlib.blake2b(key.encode(), digest_size=12).hexdigest()
-        return os.path.join(self.dir, f"{h}.pkl")
+        return os.path.join(self.dir, f"{h}.rec")
 
     def _page_out(self, key: str, value: Any) -> None:
         path = self._path(key)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
-            pickle.dump((key, value), f, protocol=_PROTO)
+            f.write(records.dumps((key, value)))
         os.replace(tmp, path)
         self._cold.add(key)
 
     def _page_in(self, key: str) -> Any:
         with open(self._path(key), "rb") as f:
-            stored_key, value = pickle.load(f)
+            stored_key, value = records.loads(f.read())
         if stored_key != key:
             raise KeyError(key)  # hash collision with a different key
         return value

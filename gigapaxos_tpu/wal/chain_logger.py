@@ -12,9 +12,10 @@ from __future__ import annotations
 
 import collections
 import io
-import pickle
 
 import numpy as np
+
+from . import records
 
 from .logger import PaxosLogger, replay_journals
 
@@ -57,7 +58,7 @@ def recover_chain(cfg, n_replicas: int, apps, log_dir: str, native: bool = True)
     start_seq = 0
     if snap_seq is not None:
         with open(logger._snapshot_path(snap_seq), "rb") as f:
-            meta, npz_blob = pickle.loads(f.read())
+            meta, npz_blob = records.loads(f.read())
         arrs = np.load(io.BytesIO(npz_blob))
         m.state = ChainState(
             **{f: jnp.asarray(arrs[f]) for f in ChainState._fields}

@@ -29,11 +29,11 @@ from __future__ import annotations
 import glob
 import io
 import os
-import pickle
 from typing import List, Optional
 
 import numpy as np
 
+from . import records
 from ..paxos.state import PaxosState
 
 OP_CREATE = 1
@@ -93,24 +93,33 @@ class PaxosLogger:
 
     # ----------------------------------------------------------------- logging
     def log_create(self, name: str, members: List[int], epoch: int) -> None:
-        self.journal.append(pickle.dumps((OP_CREATE, name, members, epoch)))
+        self.journal.append(records.dumps((OP_CREATE, name, members, epoch)))
+        self.journal.sync()
+
+    def log_creates(self, names, members: List[int], epoch: int) -> None:
+        """Batched create logging: individual OP_CREATE records (replay is
+        unchanged), ONE group-commit fsync."""
+        for name in names:
+            self.journal.append(
+                records.dumps((OP_CREATE, name, list(members), epoch))
+            )
         self.journal.sync()
 
     def log_remove(self, name: str) -> None:
-        self.journal.append(pickle.dumps((OP_REMOVE, name)))
+        self.journal.append(records.dumps((OP_REMOVE, name)))
         self.journal.sync()
 
     def log_pause(self, names) -> None:
         """Pause/unpause change row allocation, and journaled tick records
         address groups BY ROW — replay must re-apply the same spills in the
         same order or placements would land on the wrong groups."""
-        self.journal.append(pickle.dumps((OP_PAUSE, list(names))))
+        self.journal.append(records.dumps((OP_PAUSE, list(names))))
 
     def log_unpause(self, name: str) -> None:
-        self.journal.append(pickle.dumps((OP_UNPAUSE, name)))
+        self.journal.append(records.dumps((OP_UNPAUSE, name)))
 
     def log_sync(self, r: int, name: str, donor: int) -> None:
-        self.journal.append(pickle.dumps((OP_SYNC, r, name, donor)))
+        self.journal.append(records.dumps((OP_SYNC, r, name, donor)))
 
     def log_inbox(self, tick_num: int, inbox) -> None:
         """Called by the manager after `_build_inbox`, before running the
@@ -149,7 +158,7 @@ class PaxosLogger:
             kv_reg = tuple(a.tobytes() for a in up)
             m._kv_uploaded = None
         self.journal.append(
-            pickle.dumps((OP_TICK, tick_num, placed_with_payloads, alive,
+            records.dumps((OP_TICK, tick_num, placed_with_payloads, alive,
                           bulk, kv_reg))
         )
         self._ticks_since_sync += 1
@@ -260,7 +269,7 @@ class PaxosLogger:
         meta = self._meta(m)
         buf = io.BytesIO()
         np.savez_compressed(buf, **state_np)
-        blob = pickle.dumps((meta, buf.getvalue()))
+        blob = records.dumps((meta, buf.getvalue()))
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(blob)
@@ -310,7 +319,7 @@ def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
         if seq < start_seq:
             continue
         for raw in read_journal(path):
-            rec = pickle.loads(raw)
+            rec = records.loads(raw)
             op = rec[0]
             if op == OP_CREATE:
                 _, name, members, epoch = rec
@@ -392,7 +401,7 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
     start_seq = 0
     if snap_seq is not None:
         with open(logger._snapshot_path(snap_seq), "rb") as f:
-            meta, npz_blob = pickle.loads(f.read())
+            meta, npz_blob = records.loads(f.read())
         arrs = np.load(io.BytesIO(npz_blob))
         m.state = PaxosState(**{f: jnp.asarray(arrs[f]) for f in PaxosState._fields})
         m._member_np = np.asarray(m.state.member).copy()

@@ -1,0 +1,113 @@
+"""Mode B pause/spill: the per-process deployment can exceed its
+preallocated device rows (PaxosManager.java:2284-2365 deactivation; pause
+tables SQLPaxosLogger.java:4044-4048) — groups demand-page out when locally
+quiescent and back in on local proposes, peer frames, forwards, or whois.
+"""
+
+import numpy as np
+import pytest
+
+from gigapaxos_tpu.config import GigapaxosTpuConfig
+from gigapaxos_tpu.models.replicable import KVApp
+from gigapaxos_tpu.modeb import ModeBLogger, ModeBNode, recover_modeb
+
+from test_modeb import IDS, Cluster, make_cfg
+
+
+def test_create_past_max_groups_spills():
+    """With every row occupied, create evicts the coldest quiescent group
+    instead of failing; the spilled group comes back on demand."""
+    cfg = make_cfg(groups=4)
+    cfg.paxos.deactivation_ticks = 4
+    c = Cluster(cfg)
+    try:
+        for i in range(4):
+            c.create(f"g{i}")
+            assert c.commit("N0", f"g{i}", f"PUT k v{i}".encode()) == b"OK"
+        # table full; a 5th create must spill one of g0..g3
+        c.create("g4")
+        n0 = c.nodes["N0"]
+        assert n0.rows.row("g4") is not None
+        assert n0.paused_count() >= 1
+        assert c.commit("N0", "g4", b"PUT k v4") == b"OK"
+        # the spilled group still answers: demand-page back in
+        spilled = [f"g{i}" for i in range(4)
+                   if n0.rows.row(f"g{i}") is None][0]
+        assert c.commit("N0", spilled, b"GET k") != b"NF"
+    finally:
+        c.close()
+
+
+def test_idle_groups_pause_and_unpause_via_peer_traffic():
+    cfg = make_cfg(groups=8)
+    cfg.paxos.deactivation_ticks = 16
+    c = Cluster(cfg)
+    try:
+        c.create("cold")
+        c.create("hot")
+        assert c.commit("N1", "cold", b"PUT x 1") == b"OK"
+        # hot keeps committing while cold idles past the deactivation bar
+        for i in range(12):
+            assert c.commit("N0", "hot", f"PUT y {i}".encode()) == b"OK"
+            c.ticks(24)
+        assert any(n.paused_count() for n in c.nodes.values()), \
+            "no node ever paused the idle group"
+        # a commit at ANOTHER node reaches nodes that paused it (frame /
+        # forward demand-paging) and state is intact
+        assert c.commit("N2", "cold", b"GET x") == b"1"
+        assert c.commit("N0", "cold", b"GET x") == b"1"
+    finally:
+        c.close()
+
+
+def test_pause_survives_crash_recovery(tmp_path):
+    cfg = make_cfg(groups=4)
+    cfg.paxos.deactivation_ticks = 4
+    c = Cluster(cfg, wal_root=tmp_path)
+    try:
+        for i in range(5):  # 5 groups > 4 rows: forces a spill
+            c.create(f"g{i}")
+            assert c.commit("N0", f"g{i}", f"PUT k v{i}".encode()) == b"OK"
+        n0 = c.nodes["N0"]
+        assert n0.paused_count() >= 1
+        paused_names = [f"g{i}" for i in range(5)
+                        if n0.rows.row(f"g{i}") is None]
+        # crash N0 (journal is durable), recover from its own disk
+        c.nodes["N0"].wal.journal.sync()
+        c.msgs["N0"].close()
+        n0b = recover_modeb(cfg, IDS, "N0", KVApp(), str(tmp_path / "N0"),
+                            native=False)
+        assert n0b.paused_count() == n0.paused_count()
+        for name in paused_names:
+            assert name in n0b._paused
+            # spilled state answers after recovery via local unpause
+            row = n0b._unpause(name)
+            assert row is not None
+            assert n0b.group_members(name) == [0, 1, 2]
+    finally:
+        c.close()
+
+
+def test_spill_scale_packs_many_groups_per_row():
+    """A single node cycles 64 groups through 8 rows — population 8x the
+    device allocation."""
+    cfg = make_cfg(groups=8)
+    cfg.paxos.deactivation_ticks = 2
+    app = KVApp()
+    n = ModeBNode(cfg, ["N0"], "N0", app)  # 1-replica group: self-quorum
+    done = []
+    for i in range(64):
+        assert n.create_group(f"s{i}", [0]), f"create s{i} failed"
+        n.propose(f"s{i}", f"PUT k v{i}".encode(),
+                  lambda rid, resp: done.append(resp))
+        for _ in range(6):
+            n.tick()
+    assert len(done) == 64 and all(r == b"OK" for r in done)
+    assert n.paused_count() >= 64 - 8
+    # every group's state is reachable again on demand
+    for i in (0, 13, 37, 63):
+        got = []
+        n.propose(f"s{i}", b"GET k", lambda rid, resp: got.append(resp))
+        for _ in range(8):
+            n.tick()
+        assert got == [f"v{i}".encode()], (i, got)

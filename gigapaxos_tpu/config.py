@@ -91,6 +91,15 @@ class PaxosTuning:
     # Max descriptor uploads per tick (0 = auto: 2 * max_groups).  Staged
     # admissions beyond it defer (their placement waits with them).
     kv_reg_budget: int = 0
+    # Digest-only accepts (PendingDigests, paxosutil/PendingDigests.java:23;
+    # match/release PaxosInstanceStateMachine.java:1089-1102, undigest
+    # :1257-1268): the ENTRY node broadcasts a request's payload once; the
+    # coordinator's frames place only the rid (the ring columns are already
+    # digest-shaped), and a receiver holding a rid without its payload
+    # resolves it with an undigest fetch before execution.  Off by default
+    # (SURVEY: bandwidth on ICI is cheap); turn on for fat payloads on
+    # thin DCN links.
+    digest_accepts: bool = False
     # Tick coalescing: minimum spacing between driver ticks while busy.
     # Each tick has a fixed host cost (admission, placement, compaction
     # unpack); spacing ticks lets requests accumulate so that cost

@@ -202,15 +202,24 @@ class ModeBCommon:
                 mask |= self._occupied & (
                     self._ae_phase == self.tick_num % self.anti_entropy_every
                 )
+        digest = getattr(self, "_digest_accepts", False)
         pay = []
         for row, take in self._placed:
             for rid, _p in take:
+                if digest and (rid >> RID_SHIFT) != self.r:
+                    # digest mode: the ENTRY node already broadcast this
+                    # payload; the coordinator places only the rid
+                    continue
                 rec = self.outstanding.get(rid)
                 if rec is not None:
                     pay.append((rid, rec.stop, rec.payload))
                 elif rid in self.payloads:
                     pl, stop = self.payloads[rid]
                     pay.append((rid, stop, pl))
+        extra = getattr(self, "_extra_pay", None)
+        if extra:
+            pay.extend(extra)
+            extra.clear()
         return full, mask, pay
 
     def _build_frames_common(self, row_wire_bytes: int, extract, encode):

@@ -87,23 +87,14 @@ def main() -> None:
         for a in apps:
             a.row_of = m.rows.row
 
-    # bulk-create all groups (batched createPaxosInstance; the per-name
-    # admin path is control-plane, not the measurement)
+    # bulk-create all groups through the real admin path (batched
+    # createPaxosInstance: one device call + one WAL group-commit)
     t0 = time.perf_counter()
-    from gigapaxos_tpu.paxos import state as st
-
-    rows = np.arange(G, dtype=np.int32)
-    m.state = st.create_groups(m.state, rows, np.ones((G, R), bool))
-    for i in range(G):
-        m.rows._name_to_row[f"g{i}"] = i
-        m.rows._row_to_name[i] = f"g{i}"
-    m.rows._free = []
-    m._member_np[:, :] = True
-    m._n_members_np[:] = R
-    m._member_bits[:] = (1 << R) - 1
-    m._row_name_np[:] = [f"g{i}" for i in range(G)]
-    m._member_ord = None
+    names = [f"g{i}" for i in range(G)]
+    made = m.create_paxos_instances(names, list(range(R)))
+    assert made == G, f"bulk create made {made} of {G}"
     create_s = time.perf_counter() - t0
+    rows = np.array([m.rows.row(n) for n in names], np.int32)
 
     # pre-generated request waves (TESTPaxosClient pre-generates too); the
     # payloads are distinct 8-byte deltas so nothing is amortized unfairly

@@ -252,7 +252,7 @@ class ModeBNode(ModeBCommon):
                 n for n in names
                 if n not in self.rows and n not in self._paused
             ))
-            take = fresh[:len(self.rows._free)]
+            take = fresh[:self.rows.free_count()]
             rest = fresh[len(take):]
             if take:
                 rows = np.array([self.rows.alloc(n) for n in take], np.int32)

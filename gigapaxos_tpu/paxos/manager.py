@@ -231,6 +231,55 @@ class PaxosManager:
             self.wal.log_create(name, members, epoch)
         return True
 
+    def create_paxos_instances(
+        self, names: List[str], members: List[int], epoch: int = 0
+    ) -> int:
+        """Batched createPaxosInstance: one device call + one WAL
+        group-commit for the whole batch (the BatchedCreateServiceName
+        shape, gigapaxos/PaxosManager.java:611 + batched creates).  Returns
+        how many were created; names already present are skipped and
+        capacity overflow spills to the single-create path (which can
+        evict cold rows)."""
+        if not all(0 <= m < self.R for m in members):
+            raise ValueError(f"member slots out of range [0, {self.R}): "
+                             f"{members}")
+        with self.lock:
+            fresh = list(dict.fromkeys(  # order-preserving dedup
+                n for n in names
+                if n not in self.rows and n not in self._paused
+            ))
+            take = fresh[:self.rows.free_count()]
+            rest = fresh[len(take):]
+            if take:
+                rows = np.array([self.rows.alloc(n) for n in take], np.int32)
+                mask = np.zeros((len(take), self.R), bool)
+                mask[:, members] = True
+                self.state = st.create_groups(
+                    self.state, rows, mask,
+                    np.full(len(take), epoch, np.int32),
+                )
+                # vectorized host-mirror refresh (the batched analog of
+                # _set_member_row)
+                self._member_np[:, rows] = mask.T
+                self._n_members_np[rows] = mask.sum(axis=1)
+                bits = int(np.bitwise_or.reduce(
+                    (1 << np.array(members, np.int64))
+                )) if members else 0
+                self._member_bits[rows] = bits
+                self._row_name_np[rows] = take
+                self._member_ord = None
+                self._stopped_np[rows] = False
+                self._stopped_rows.difference_update(int(r) for r in rows)
+                self._last_active[rows] = self.tick_num
+                if self.wal is not None:
+                    # one fsync for the whole batch, not one per name
+                    self.wal.log_creates(take, list(members), epoch)
+            made = len(take)
+        for n in rest:  # overflow: single-create path (may evict)
+            if self.create_paxos_instance(n, list(members), epoch):
+                made += 1
+        return made
+
     def _set_member_row(self, row, mask, name) -> None:
         """Refresh every host mirror of one row's config (mask: [R] bool)."""
         self._member_np[:, row] = mask

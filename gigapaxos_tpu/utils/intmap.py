@@ -30,6 +30,9 @@ class RowAllocator:
     def full(self) -> bool:
         return not self._free
 
+    def free_count(self) -> int:
+        return len(self._free)
+
     def alloc(self, name: str) -> int:
         if name in self._name_to_row:
             raise KeyError(f"{name!r} already allocated")

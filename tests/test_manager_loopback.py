@@ -183,3 +183,71 @@ def test_responses_held_until_group_commit(tmp_path):
     m.tick()  # 4th tick triggers the group commit
     assert got == [b"ok:x"]
     m.wal.close()
+
+
+def test_bulk_create_matches_single_create():
+    """create_paxos_instances (batched admin path, PaxosManager.java:611 +
+    BatchedCreateServiceName) behaves like N single creates: same rows,
+    same mirrors, groups fully usable, dups/overflow handled."""
+    m = mk_manager(groups=32)
+    made = m.create_paxos_instances([f"b{i}" for i in range(8)], [0, 1, 2])
+    assert made == 8
+    # dup skip
+    assert m.create_paxos_instances(["b0", "b8"], [0, 1, 2]) == 1
+    # mirrors match the single-create path
+    m2 = mk_manager(groups=32)
+    for i in range(8):
+        m2.create_paxos_instance(f"b{i}", [0, 1, 2])
+    m2.create_paxos_instance("b8", [0, 1, 2])
+    for name in [f"b{i}" for i in range(9)]:
+        r1, r2 = m.rows.row(name), m2.rows.row(name)
+        assert r1 == r2
+        assert (m._member_np[:, r1] == m2._member_np[:, r2]).all()
+        assert m._member_bits[r1] == m2._member_bits[r2]
+        assert m._n_members_np[r1] == m2._n_members_np[r2]
+        assert m._row_name_np[r1] == name
+    assert m.group_members("b3") == [0, 1, 2]
+    # groups are usable end-to-end
+    got = {}
+    for i in range(9):
+        m.propose(f"b{i}", b"x", lambda rid, resp, i=i: got.__setitem__(i, resp))
+    m.run_ticks(6)
+    assert got == {i: b"ok:x" for i in range(9)}
+
+
+def test_bulk_create_overflow_spills_to_single_path():
+    m = mk_manager(groups=4)
+    made = m.create_paxos_instances([f"o{i}" for i in range(6)], [0, 1])
+    # 4 fit; the remaining 2 go through the evicting single-create path,
+    # which only evicts quiescent groups — fresh never-used groups qualify
+    assert made == 6
+    assert len(m.rows) + len(m._paused) == 6
+
+
+def test_bulk_create_wal_replay(tmp_path):
+    """Batch-created groups journal via the one-fsync log_creates path and
+    replay to the same rows (the live/replay row-lockstep invariant)."""
+    from gigapaxos_tpu.wal import logger as wl
+
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 32
+    wal = wl.PaxosLogger(str(tmp_path / "wal"))
+    m = PaxosManager(cfg, 3, [KVApp() for _ in range(3)], wal=wal)
+    assert m.create_paxos_instances([f"w{i}" for i in range(6)], [0, 1, 2]) == 6
+    import pytest
+
+    with pytest.raises(ValueError):
+        m.create_paxos_instances(["bad"], [0, 3])
+    got = {}
+    for i in range(6):
+        m.propose(f"w{i}", f"PUT k v{i}".encode(),
+                  lambda rid, r, i=i: got.__setitem__(i, r))
+    m.run_ticks(8)
+    assert len(got) == 6
+    rows_live = {n: m.rows.row(n) for n in [f"w{i}" for i in range(6)]}
+    wal.close()
+
+    m2 = wl.recover(cfg, 3, [KVApp() for _ in range(3)], str(tmp_path / "wal"))
+    assert {n: m2.rows.row(n) for n in rows_live} == rows_live
+    for r in range(3):
+        assert m2.apps[r].db["w3"]["k"] in (b"v3", "v3")

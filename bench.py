@@ -200,6 +200,75 @@ def _cpu_fallback(diag: dict) -> dict:
     return result
 
 
+ATTEMPT_LOG = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "benchmarks",
+    "tpu_attempts.jsonl",
+)
+
+#: staged escalation (round-5 restructure, VERDICT r4 item 1): each stage is
+#: its own subprocess under its own watchdog and its result is logged
+#: IMMEDIATELY, so a tunnel that dies mid-run still leaves the completed
+#: stages' data.  (groups, ticks, device_app, timeout_s)
+STAGES = [
+    ("smoke_64k", 1 << 16, 10, False, 420.0),
+    ("full_1m", 1 << 20, 30, False, 600.0),
+    ("device_kv_1m", 1 << 20, 30, True, 480.0),
+]
+
+
+def _log_attempt(entry: dict) -> None:
+    entry = dict(entry, ts=time.strftime("%Y-%m-%dT%H:%M:%S%z"))
+    try:
+        os.makedirs(os.path.dirname(ATTEMPT_LOG), exist_ok=True)
+        with open(ATTEMPT_LOG, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError:
+        pass  # logging must never break the bench contract
+
+
+def _run_stage(name, groups, ticks, device_app, timeout_s):
+    """One TPU attempt in a fresh subprocess under its own watchdog."""
+    env = dict(os.environ)
+    env["GPTPU_BENCH_INNER"] = "1"
+    env["GPTPU_BENCH_GROUPS"] = str(groups)
+    env["GPTPU_BENCH_TICKS"] = str(ticks)
+    if device_app:
+        env["GPTPU_BENCH_APP"] = "device_kv"
+    else:
+        env.pop("GPTPU_BENCH_APP", None)
+    t0 = time.monotonic()
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        _log_attempt({"stage": name, "groups": groups, "ok": False,
+                      "error": f"timeout>{timeout_s:.0f}s",
+                      "elapsed_s": round(time.monotonic() - t0, 1)})
+        return None, "timeout"
+    if out.returncode == 0:
+        for line in reversed(out.stdout.strip().splitlines()):
+            try:
+                result = json.loads(line)
+                break
+            except ValueError:
+                continue
+        else:
+            result = None
+        if result is not None:
+            _log_attempt({"stage": name, "groups": groups, "ok": True,
+                          "value": result.get("value"),
+                          "metric": result.get("metric"),
+                          "elapsed_s": round(time.monotonic() - t0, 1)})
+            return result, None
+    err = (out.stderr.strip().splitlines() or ["no stderr"])[-1][:400]
+    _log_attempt({"stage": name, "groups": groups, "ok": False,
+                  "error": f"rc={out.returncode}: {err}",
+                  "elapsed_s": round(time.monotonic() - t0, 1)})
+    return None, err
+
+
 def main():
     if os.environ.get("GPTPU_BENCH_PLATFORM") or os.environ.get(
         "GPTPU_BENCH_INNER"
@@ -207,42 +276,55 @@ def main():
         # inner/forced-platform run: do the work directly, fail loudly
         print(json.dumps(run_bench()))
         return
-    # Orchestrator: attempt the ambient (TPU) backend in a subprocess under
-    # a watchdog — a broken tunnel can hang backend init for ~40 minutes,
-    # which must not silently eat the whole bench budget.
-    # must leave room inside the DRIVER's ~1500s budget for the CPU
-    # fallback subprocess (~3-4 min) to still emit a parseable line when
-    # the TPU attempt hangs on a dead tunnel
-    tpu_timeout = float(os.environ.get("GPTPU_BENCH_TPU_TIMEOUT_S", 1000))
-    diag = None
-    try:
-        env = dict(os.environ)
-        env["GPTPU_BENCH_INNER"] = "1"
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            capture_output=True, text=True, timeout=tpu_timeout, env=env,
+    # Orchestrator: staged TPU probe.  A broken tunnel can hang backend init
+    # for ~40 minutes; every stage runs under its own watchdog, escalating
+    # from a small smoke config to the 1M-group north-star configs, and each
+    # completed stage is a real TPU datum even if a later stage dies.  Total
+    # worst case must leave room in the driver's ~1500s budget for the CPU
+    # fallback (~3-4 min).
+    deadline = time.monotonic() + float(
+        os.environ.get("GPTPU_BENCH_TPU_TIMEOUT_S", 1100)
+    )
+    stage_results = []
+    first_error = None
+    for name, groups, ticks, device_app, timeout_s in STAGES:
+        left = deadline - time.monotonic()
+        if left < 60:
+            # a stage skipped for budget must leave a record: the emitted
+            # result would otherwise read as a complete staged run
+            _log_attempt({"stage": name, "groups": groups, "ok": False,
+                          "error": "skipped: TPU budget exhausted"})
+            first_error = first_error or f"{name}: skipped (budget)"
+            continue
+        result, err = _run_stage(
+            name, groups, ticks, device_app, min(timeout_s, left)
         )
-        if out.returncode == 0:
-            for line in reversed(out.stdout.strip().splitlines()):
-                try:
-                    print(json.dumps(json.loads(line)))
-                    return
-                except ValueError:
-                    continue
-        diag = {
-            "error": f"bench subprocess rc={out.returncode}",
-            "message": (out.stderr.strip().splitlines() or ["no stderr"])[-1][:500],
-            "note": "TPU backend init/run failed; value below is the CPU "
-                    "fallback sanity number, NOT a TPU datum",
-        }
-    except subprocess.TimeoutExpired:
-        diag = {
-            "error": "timeout",
-            "message": f"TPU bench exceeded {tpu_timeout:.0f}s watchdog "
-                       "(hung backend init or pathologically slow tunnel)",
-            "note": "value below is the CPU fallback sanity number, NOT a "
-                    "TPU datum",
-        }
+        if result is not None:
+            stage_results.append((name, groups, device_app, result))
+        else:
+            first_error = first_error or f"{name}: {err}"
+            if not stage_results:
+                break  # smoke failed: tunnel dead, don't burn the budget
+    if stage_results:
+        # headline = the most representative successful config (largest
+        # non-device-app G), with every stage's number attached
+        best = max(
+            stage_results, key=lambda e: (not e[2], e[1])
+        )[3]
+        best["stages"] = {n: {"metric": r["metric"], "value": r["value"]}
+                          for n, _g, _d, r in stage_results}
+        if first_error:
+            best["partial"] = first_error
+        print(json.dumps(best))
+        return
+    diag = {
+        "error": first_error or "no stage ran",
+        "message": "staged TPU probe failed at the smoke stage "
+                   "(hung backend init or dead tunnel); per-stage attempts "
+                   "logged in benchmarks/tpu_attempts.jsonl",
+        "note": "value below is the CPU fallback sanity number, NOT a "
+                "TPU datum",
+    }
     result = _cpu_fallback(diag)
     print(json.dumps(result))
 

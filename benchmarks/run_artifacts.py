@@ -183,6 +183,40 @@ def bench_manager_direct(groups: int = 8, n_requests: int = 4000) -> dict:
     }
 
 
+def _script(args_list, timeout=1800):
+    """Run a sibling bench script, return every JSON line it printed."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = subprocess.run(
+        [sys.executable] + args_list, capture_output=True, text=True,
+        timeout=timeout, cwd=os.path.dirname(here),
+    )
+    results = []
+    for line in out.stdout.strip().splitlines():
+        try:
+            results.append(json.loads(line))
+        except ValueError:
+            continue
+    if not results:
+        raise RuntimeError(
+            f"{args_list}: no JSON output; stderr tail: "
+            f"{out.stderr.strip()[-400:]!r}"
+        )
+    return results
+
+
+def bench_stack(extra, timeout=1800) -> dict:
+    return _script(
+        ["benchmarks/stack_bench.py", "--platform", "cpu"] + extra,
+        timeout=timeout,
+    )[-1]
+
+
+def bench_modeb_scale() -> list:
+    return _script(["benchmarks/modeb_scale.py", "--platform", "cpu"])
+
+
 def _best_of(fn, n: int) -> dict:
     """Run a bench ``n`` times and keep the best run.  The box these
     artifacts are produced on is a single shared core — interference can
@@ -196,8 +230,9 @@ def _best_of(fn, n: int) -> dict:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--round", type=int, default=3)
+    ap.add_argument("--round", type=int, default=5)
     ap.add_argument("--repeat", type=int, default=3)
+    ap.add_argument("--stack-groups", type=int, default=1 << 17)
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
@@ -217,18 +252,27 @@ def main() -> None:
         },
         "benches": [],
     }
-    t0 = time.monotonic()
-    results["benches"].append(_best_of(bench_manager_direct, args.repeat))
-    print(f"modea direct: {results['benches'][-1]['value']} commits/s "
-          f"({time.monotonic() - t0:.0f}s)", file=sys.stderr)
-    t0 = time.monotonic()
-    results["benches"].append(_best_of(bench_modeb, args.repeat))
-    print(f"modeb: {results['benches'][-1]['value']} commits/s "
-          f"({time.monotonic() - t0:.0f}s)", file=sys.stderr)
-    t0 = time.monotonic()
-    results["benches"].append(_best_of(bench_capacity, args.repeat))
-    print(f"capacity: {results['benches'][-1]['value']} req/s "
-          f"({time.monotonic() - t0:.0f}s)", file=sys.stderr)
+    def run(label, fn):
+        t0 = time.monotonic()
+        try:
+            r = fn()
+        except Exception as e:  # one failed bench must not lose the rest
+            r = {"metric": label, "error": f"{type(e).__name__}: {e}"[:400]}
+        rs = r if isinstance(r, list) else [r]
+        results["benches"].extend(rs)
+        print(f"{label}: "
+              f"{[x.get('value', x.get('error')) for x in rs]} "
+              f"({time.monotonic() - t0:.0f}s)", file=sys.stderr)
+
+    run("modea_direct", lambda: _best_of(bench_manager_direct, args.repeat))
+    run("modeb_sockets", lambda: _best_of(bench_modeb, args.repeat))
+    run("capacity_ladder", lambda: _best_of(bench_capacity, args.repeat))
+    # the full-stack numbers (VERDICT r4: committed artifact, 3 configs)
+    G = str(args.stack_groups)
+    run("stack_plain", lambda: bench_stack(["--groups", G]))
+    run("stack_wal", lambda: bench_stack(["--groups", G, "--wal"]))
+    run("stack_device", lambda: bench_stack(["--groups", G, "--device"]))
+    run("modeb_scale", bench_modeb_scale)
 
     out = args.out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
@@ -237,7 +281,7 @@ def main() -> None:
     with open(out, "w") as f:
         json.dump(results, f, indent=1)
     print(json.dumps({"written": out, "benches": [
-        {k: b[k] for k in ("metric", "value", "unit")}
+        {k: b[k] for k in ("metric", "value", "unit", "error") if k in b}
         for b in results["benches"]
     ]}))
 

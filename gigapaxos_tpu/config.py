@@ -100,6 +100,10 @@ class PaxosTuning:
     # (SURVEY: bandwidth on ICI is cheap); turn on for fat payloads on
     # thin DCN links.
     digest_accepts: bool = False
+    # How many ticks a rid-without-payload may stall its row's execution
+    # stream (undigest fetches retried underneath) before the node gives
+    # up and repairs by checkpoint transfer instead.
+    undigest_timeout_ticks: int = 256
     # Tick coalescing: minimum spacing between driver ticks while busy.
     # Each tick has a fixed host cost (admission, placement, compaction
     # unpack); spacing ticks lets requests accumulate so that cost

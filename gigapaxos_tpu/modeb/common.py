@@ -47,6 +47,12 @@ class ModeBCommon:
             collections.OrderedDict()
         )
         self._held_callbacks: list = []
+        #: extra (rid, stop, payload) items for the next frame broadcast —
+        #: the digest-mode entry-replica payload dissemination channel
+        self._extra_pay: list = []
+        #: digest-only accepts off unless the concrete node wires it from
+        #: cfg.paxos.digest_accepts
+        self._digest_accepts = False
         self._fd = None
         self.on_work: Optional[Callable[[], None]] = None
         self.whois_birth: Optional[Callable[[str], bool]] = None
@@ -202,13 +208,16 @@ class ModeBCommon:
                 mask |= self._occupied & (
                     self._ae_phase == self.tick_num % self.anti_entropy_every
                 )
-        digest = getattr(self, "_digest_accepts", False)
+        digest = self._digest_accepts
         pay = []
         for row, take in self._placed:
             for rid, _p in take:
                 if digest and (rid >> RID_SHIFT) != self.r:
-                    # digest mode: the ENTRY node already broadcast this
-                    # payload; the coordinator places only the rid
+                    # digest mode: the ENTRY node broadcast this payload
+                    # (see _forward); the coordinator's frames carry only
+                    # the rid — the digest-only ACCEPT
+                    # (PendingDigests.java:23) that cuts coordinator
+                    # egress from (R-1)x payload to ~0
                     continue
                 rec = self.outstanding.get(rid)
                 if rec is not None:

@@ -35,6 +35,7 @@ from .kernel import unpack_node_tick
 OP_FRAME = 6
 OP_CKPT = 7
 OP_EXPAND = 8
+OP_PAYLOAD = 9  # out-of-band payload arrival (undigest reply)
 
 
 def replay_node_journals(node, log_dir, start_seq, stage, new_buffers,
@@ -78,6 +79,10 @@ def replay_node_journals(node, log_dir, start_seq, stage, new_buffers,
                     stage(rec[1])
                 except (ValueError, IndexError):
                     pass  # tolerate a frame torn by the crash
+            elif op == OP_PAYLOAD:
+                _, rid, pl, stop = rec
+                if rid not in node.outstanding and rid not in node.payloads:
+                    node._store_payload(rid, pl, stop)
             elif op == OP_CKPT:
                 _, gid, packet = rec
                 row = node._gid_row.get(gid)
@@ -98,8 +103,14 @@ def replay_node_journals(node, log_dir, start_seq, stage, new_buffers,
                                 node._next_seq, (rid & RID_MASK) + 1
                             )
                         placed_rids.add(rid)
-                        if (rid not in node.outstanding
-                                and rid not in node.payloads):
+                        # payload None = digest-only placement (the rid was
+                        # placed before its payload arrived); replay places
+                        # it identically and execution follows the same
+                        # learned-payload / taint path as the live run
+                        if payload is not None and (
+                            rid not in node.outstanding
+                            and rid not in node.payloads
+                        ):
                             node._store_payload(rid, payload, stop)
                         place(bufs, p, row, rid, stop)
                         take.append((rid, p))
@@ -116,6 +127,9 @@ def replay_node_journals(node, log_dir, start_seq, stage, new_buffers,
                     bufs, np.frombuffer(alive_b, dtype=bool)
                 )
                 node._process_outbox(out)
+                drain = getattr(node, "_drain_stalled", None)
+                if drain is not None:  # digest-mode stalls release as the
+                    drain()            # journaled payload arrivals replay
                 node._dirty |= changed
                 node.tick_num = tick_num + 1
 
@@ -133,6 +147,11 @@ class ModeBLogger(PaxosLogger):
         the next tick's group commit for fsync)."""
         self.journal.append(records.dumps((OP_FRAME, payload)))
 
+    def log_payload(self, rid: int, payload: bytes, stop: bool) -> None:
+        """Journal an out-of-band payload fill (undigest reply): it changes
+        what replay can execute, exactly like a frame's payload items."""
+        self.journal.append(records.dumps((OP_PAYLOAD, rid, payload, stop)))
+
     def log_ckpt(self, gid: int, packet: dict) -> None:
         """Journal an adopted checkpoint transfer — it mutates own-row state
         outside the deterministic tick, so replay must re-apply it."""
@@ -141,6 +160,7 @@ class ModeBLogger(PaxosLogger):
 
     def log_inbox(self, tick_num: int, inbox) -> None:
         m = self.manager
+        digest_meta = getattr(m, "_digest_meta", {})
         placed = []
         for row, take in m._placed:
             entries = []
@@ -151,6 +171,11 @@ class ModeBLogger(PaxosLogger):
                 elif rid in m.payloads:
                     pl, stop = m.payloads[rid]
                     entries.append((rid, p, pl, stop))
+                elif rid in digest_meta:
+                    # digest placement before its payload arrived: journal
+                    # the placement itself (payload None) so replay's tick
+                    # evolves state identically
+                    entries.append((rid, p, None, digest_meta[rid]))
             if entries:
                 placed.append((row, entries))
         alive = np.asarray(inbox.alive).tobytes()
@@ -180,6 +205,14 @@ class ModeBLogger(PaxosLogger):
                 for r in m.outstanding.values()
             ],
             "queues": {row: list(q) for row, q in m._queues.items() if q},
+            # digest-mode soft state: stop flags of payload-less queued
+            # rids, and stalled execution buffers (their slots are already
+            # inside the device exec watermark, so losing them would
+            # silently skip executions)
+            "digest_meta": list(getattr(m, "_digest_meta", {}).items()),
+            "stalled": {row: list(q)
+                        for row, q in getattr(m, "_stalled", {}).items()},
+            "stall_tick": dict(getattr(m, "_stall_tick", {})),
             "coord_view": m._coord_view.tobytes(),
             "frame_applied": dict(m._frame_applied_tick),
             # paused names keep app state; the snapshot must carry both
@@ -248,6 +281,14 @@ def recover_modeb(cfg, member_ids, node_id, app, log_dir: str,
             node.outstanding[rid] = rec
         for row, rids in meta["queues"].items():
             node._queues[int(row)] = collections.deque(rids)
+        for rid, stop in meta.get("digest_meta", ()):
+            node._digest_meta[rid] = stop
+        for row, items in (meta.get("stalled") or {}).items():
+            node._stalled[int(row)] = collections.deque(
+                tuple(e) for e in items
+            )
+        node._stall_tick = {int(r): t for r, t in
+                            (meta.get("stall_tick") or {}).items()}
         node._coord_view = np.frombuffer(
             meta["coord_view"], dtype=np.int32
         ).copy()
@@ -289,6 +330,10 @@ def recover_modeb(cfg, member_ids, node_id, app, log_dir: str,
                               len(node.payloads)))
     node.bump_seq(np.fromiter(node.outstanding.keys(), np.int64,
                               len(node.outstanding)))
+    # rows still stalled on a payload when replay ends get a fresh timeout
+    # window: live undigest fetches resume once the messenger is attached
+    for row in node._stalled:
+        node._stall_tick[row] = node.tick_num
     logger.attach(node)
     node.wal = logger
     node._force_full = True  # re-announce our row to peers on rejoin

@@ -56,6 +56,8 @@ from .kernel import (frame_extract, mirror_apply, node_tick_packed,
 from .common import RID_MASK, RID_SHIFT, ModeBCommon, rid_origin  # noqa: E402,F401
 
 MB_PROPOSAL = "mb_proposal"
+MB_UNDIGEST = "mb_undigest"
+MB_UNDIGEST_REPLY = "mb_undigest_reply"
 MB_WHOIS = "mb_whois"
 MB_WHOIS_REPLY = "mb_whois_reply"
 MB_SYNC_REQ = "mb_sync_req"
@@ -169,6 +171,22 @@ class ModeBNode(ModeBCommon):
         #: id in one process share a namespace; their slot-tagged rids can
         #: then collide — acceptable for a debug facility.)
         self.reqtrace = _reqtrace(f"mbu:{self.members[0]}")
+        # ---- digest-only accepts (PendingDigests.java:23) ----
+        self._digest_accepts = bool(cfg.paxos.digest_accepts)
+        #: rid -> stop flag for digest proposals whose payload has not
+        #: arrived yet (placement needs only the rid + stop)
+        self._digest_meta: "collections.OrderedDict[int, bool]" = (
+            collections.OrderedDict()
+        )
+        #: rows whose execution stream is held on a rid-without-payload
+        #: (the PendingDigests "accept waits for its payload" analog, moved
+        #: to the execution edge — our accepts are rid-only by design);
+        #: row -> deque[(name, rid, slot, is_stop)], drained in slot order
+        self._stalled: Dict[int, collections.deque] = {}
+        self._stall_tick: Dict[int, int] = {}
+        self._undigest_asked: "collections.OrderedDict[int, int]" = (
+            collections.OrderedDict()
+        )
         self._pending_whois: set = set()
         #: decoded frames awaiting the once-per-tick fused mirror apply:
         #: (sender_r, local_rows, frame_row_selector, Frame)
@@ -203,6 +221,8 @@ class ModeBNode(ModeBCommon):
 
         d.bytes_handler = on_bytes
         self.m.register(MB_PROPOSAL, self._on_proposal)
+        self.m.register(MB_UNDIGEST, self._on_undigest)
+        self.m.register(MB_UNDIGEST_REPLY, self._on_undigest_reply)
         self.m.register(MB_WHOIS, self._on_whois)
         self.m.register(MB_WHOIS_REPLY, self._on_whois_reply)
         self.m.register(MB_SYNC_REQ, self._on_sync_req)
@@ -308,6 +328,8 @@ class ModeBNode(ModeBCommon):
             self._gid_row.pop(wire.gid_of(name), None)
             self._row_meta.pop(row, None)
             self._queues.pop(row, None)
+            self._stalled.pop(row, None)
+            self._stall_tick.pop(row, None)
             self._stopped_rows.discard(row)
             self._occupied[row] = False
             self._dirty[row] = False
@@ -353,7 +375,8 @@ class ModeBNode(ModeBCommon):
             if len(names) >= limit:
                 break
             if (self._queues.get(row) or row in busy_rows
-                    or row in self._tainted_rows):
+                    or row in self._tainted_rows
+                    or row in self._stalled):
                 continue
             name = self.rows.name(row)
             if name is not None:
@@ -543,13 +566,27 @@ class ModeBNode(ModeBCommon):
         if self.m is None:
             self._queues[rec.row].append(rec.rid)  # replay: keep local
             return
-        self.m.send(self.members[coord], {
+        msg = {
             "type": MB_PROPOSAL,
             "rid": rec.rid,
             "gid": str(wire.gid_of(rec.name)),
-            "payload": rec.payload.hex(),
             "stop": rec.stop,
-        })
+        }
+        if self._digest_accepts:
+            # digest mode: the proposal to the coordinator is rid-only;
+            # WE (the entry replica) broadcast the payload to every peer
+            # on this tick's frames (PendingDigests' entry-replica
+            # broadcast, PaxosInstanceStateMachine.java:1089-1102)
+            self._extra_pay.append((rec.rid, rec.stop, rec.payload))
+            if self.wal is not None:
+                # non-digest replay re-learns a forwarded payload from the
+                # coordinator's (journaled) frames; digest frames are
+                # rid-only, so the entry's own journal is the ONLY durable
+                # home of this payload — record it or replay stalls on it
+                self.wal.log_payload(rec.rid, rec.payload, rec.stop)
+        else:
+            msg["payload"] = rec.payload.hex()
+        self.m.send(self.members[coord], msg)
         self.stats["forwarded"] += 1
         if self.reqtrace.enabled:
             self.reqtrace.event(rec.rid, "forwarded",
@@ -558,7 +595,7 @@ class ModeBNode(ModeBCommon):
     def _on_proposal(self, sender: str, p: dict) -> None:
         rid = int(p["rid"])
         gid = int(p["gid"])
-        payload = bytes.fromhex(p["payload"])
+        payload = bytes.fromhex(p["payload"]) if "payload" in p else None
         stop = bool(p.get("stop"))
         with self.lock:
             row = self._gid_row.get(gid)
@@ -576,12 +613,22 @@ class ModeBNode(ModeBCommon):
             # Retransmission dedup instead rides _routed: every rid we ever
             # queued for proposal, GC'd at the same depth as the payload
             # table (GCConcurrentHashMap of outstanding, PaxosManager.java:189).
-            self._store_payload(rid, payload, stop)
+            if payload is not None:
+                self._store_payload(rid, payload, stop)
+            else:
+                # digest-only proposal: placement needs just rid + stop;
+                # the payload arrives on the entry replica's frames
+                self._digest_note(rid, stop)
             if not self._mark_routed(rid):
                 return  # duplicate/late forward of a rid we already proposed
             if rid not in self._queues[row]:
                 self._queues[row].append(rid)
         self._wake()
+
+    def _digest_note(self, rid: int, stop: bool) -> None:
+        self._digest_meta[rid] = stop
+        while len(self._digest_meta) > self._payload_cap:
+            self._digest_meta.popitem(last=False)
 
     # ------------------------------------------------------------------- tick
     def tick(self):
@@ -675,10 +722,18 @@ class ModeBNode(ModeBCommon):
             p = 0
             while q and p < self.P:
                 rid = q.popleft()
-                if rid not in self.outstanding and rid not in self.payloads:
-                    continue
                 rec = self.outstanding.get(rid)
-                stop = rec.stop if rec is not None else self.payloads[rid][1]
+                if rec is not None:
+                    stop = rec.stop
+                elif rid in self.payloads:
+                    stop = self.payloads[rid][1]
+                elif rid in self._digest_meta:
+                    # digest-only proposal: place the rid now — the accept
+                    # rings are rid-only anyway; execution stalls on the
+                    # payload if it has not arrived by commit time
+                    stop = self._digest_meta[rid]
+                else:
+                    continue
                 req[self.r, p, row] = rid
                 stp[self.r, p, row] = stop
                 take.append((rid, p))
@@ -695,6 +750,7 @@ class ModeBNode(ModeBCommon):
         """Consume one tick's outbox: requeue rejected intake, execute the
         decision stream, release durable callbacks, periodic repair/GC."""
         self._process_outbox(out, placed)
+        self._drain_stalled()
         self._flush_callbacks()
         if self.tick_num % 16 == 0 or self._tainted_rows:
             self._check_laggard(out)
@@ -734,6 +790,15 @@ class ModeBNode(ModeBCommon):
 
     def _execute_one(self, row: int, name: str, rid: int, slot: int,
                      is_stop: bool) -> None:
+        if row in self._stalled:
+            # an earlier slot of this row is waiting on its payload: every
+            # later decision buffers behind it — RSM order is absolute
+            self._stalled[row].append((name, rid, slot, is_stop))
+            return
+        self._execute_direct(row, name, rid, slot, is_stop)
+
+    def _execute_direct(self, row: int, name: str, rid: int, slot: int,
+                        is_stop: bool) -> None:
         self._row_last_active[row] = self.tick_num
         if is_stop and row not in self._stopped_rows:
             self._stopped_rows.add(row)
@@ -758,13 +823,31 @@ class ModeBNode(ModeBCommon):
             payload, _ = rec.payload, rec.stop
         elif rid in self.payloads:
             payload = self.payloads[rid][0]
+        elif self._digest_accepts:
+            # digest mode: a decision routinely commits before its payload
+            # arrives — HOLD this row's execution stream and fetch the
+            # payload (the PendingDigests match/undigest protocol,
+            # PaxosInstanceStateMachine.java:1089-1102, 1257-1268).  The
+            # app state is NOT diverged; it is merely behind.  During WAL
+            # replay the same stall happens and drains from journaled
+            # frame/OP_PAYLOAD arrivals (_undigest no-ops without a
+            # transport); rows still stalled when replay ends resolve by
+            # live undigest after rejoin, or time out into taint.
+            seen.pop(rid, None)  # the drain re-enters the full path
+            q = collections.deque()
+            q.append((name, rid, slot, is_stop))
+            self._stalled[row] = q
+            self._stall_tick[row] = self.tick_num
+            self.stats["stalled_rows"] += 1
+            self._undigest(rid, row)
+            return
         else:
-            # decision learned but payload never seen (GC'd or dropped with
-            # a dead peer's backlog): the slot was skipped, so our app copy
-            # has DIVERGED — taint the row; a checkpoint transfer from an
-            # untainted donor repairs it (execute-retry-forever is the
-            # reference's answer, PaxosInstanceStateMachine.java:1829-1839;
-            # ours is repair-by-StatePacket since the payload is gone)
+            # payload never seen (GC'd or dropped with a dead peer's
+            # backlog): the slot was skipped, so our app copy has DIVERGED
+            # — taint the row; a checkpoint transfer from an untainted
+            # donor repairs it (execute-retry-forever is the reference's
+            # answer, PaxosInstanceStateMachine.java:1829-1839; ours is
+            # repair-by-StatePacket since the payload is gone)
             self.stats["orphan_execs"] += 1
             self._tainted_rows.add(row)
             return
@@ -779,6 +862,101 @@ class ModeBNode(ModeBCommon):
                 self._held_callbacks.append((rec.callback, rid, response))
             if self.reqtrace.enabled:
                 self.reqtrace.event(rid, "responded", node=self.node_id)
+
+    # --------------------------------------------- digest stall / undigest
+    def _drain_stalled(self) -> None:
+        """Release stalled rows whose head payload has arrived (in slot
+        order); re-fetch or give up (taint + checkpoint repair) on the
+        rest.  Runs once per completed tick."""
+        if not self._stalled:
+            return
+        for row in list(self._stalled):
+            q = self._stalled.pop(row)
+            t0 = self._stall_tick.pop(row)
+            progressed = False
+            while q:
+                name, rid, slot, is_stop = q[0]
+                if not (rid == NO_REQUEST or rid in self.outstanding
+                        or rid in self.payloads):
+                    break
+                q.popleft()
+                # payload verified present and the row is no longer in
+                # _stalled, so this cannot re-stall or re-buffer
+                self._execute_direct(row, name, rid, slot, is_stop)
+                progressed = True
+            if not q:
+                self.stats["stalls_drained"] += 1
+                continue
+            head_rid = q[0][1]
+            age = self.tick_num - t0
+            if not progressed and (
+                age > self.cfg.paxos.undigest_timeout_ticks
+                or len(q) > 8 * self.W
+            ):
+                # unrecoverable (origin died before anyone learned the
+                # payload): fall back to divergence repair by checkpoint
+                # transfer
+                self.stats["orphan_execs"] += len(q)
+                self._tainted_rows.add(row)
+                continue
+            self._stalled[row] = q
+            self._stall_tick[row] = self.tick_num if progressed else t0
+            self._undigest(head_rid, row)
+
+    def _undigest(self, rid: int, row: int) -> None:
+        """Fetch a committed-but-unseen payload: ask the rid's ORIGIN node
+        (encoded in the rid's high bits — it broadcast the payload and
+        keeps it in `outstanding`), falling back to the coordinator, then
+        any live peer.  Rate-limited per rid."""
+        if self.m is None:
+            return
+        last, tries = self._undigest_asked.get(rid, (-(1 << 30), 0))
+        if self.tick_num - last < 8:
+            return
+        self._undigest_asked[rid] = (self.tick_num, tries + 1)
+        while len(self._undigest_asked) > self._payload_cap:
+            self._undigest_asked.popitem(last=False)
+        origin = rid_origin(rid)
+        cands = [origin, int(self._coord_view[row])] + list(range(self.R))
+        live = []
+        for t in cands:
+            if 0 <= t < self.R and t != self.r and self.alive[t] \
+                    and t not in live:
+                live.append(t)
+        if not live:
+            return
+        # rotate across retries: an ALIVE origin that GC'd the payload must
+        # not absorb every ask while a peer still holds it
+        t = live[tries % len(live)]
+        self.m.send(self.members[t], {"type": MB_UNDIGEST, "rid": rid})
+        self.stats["undigest_reqs"] += 1
+
+    def _on_undigest(self, sender: str, p: dict) -> None:
+        rid = int(p["rid"])
+        with self.lock:
+            rec = self.outstanding.get(rid)
+            if rec is not None:
+                pl, stop = rec.payload, rec.stop
+            elif rid in self.payloads:
+                pl, stop = self.payloads[rid]
+            else:
+                return  # never saw it; the asker tries other peers
+        self.m.send(sender, {"type": MB_UNDIGEST_REPLY, "rid": rid,
+                             "payload": pl.hex(), "stop": stop})
+
+    def _on_undigest_reply(self, sender: str, p: dict) -> None:
+        rid = int(p["rid"])
+        pl = bytes.fromhex(p["payload"])
+        stop = bool(p.get("stop"))
+        with self.lock:
+            if rid not in self.outstanding and rid not in self.payloads:
+                self._store_payload(rid, pl, stop)
+                self.stats["undigest_fills"] += 1
+                if self.wal is not None:
+                    # out-of-band payload arrival mutates what replay can
+                    # execute — journal it like a frame payload
+                    self.wal.log_payload(rid, pl, stop)
+        self._wake()
 
     def _sweep(self) -> None:
         gone = []
@@ -1030,6 +1208,16 @@ class ModeBNode(ModeBCommon):
             exec_slot=self.state.exec_slot.at[self.r, row].set(donor_exec),
             status=self.state.status.at[self.r, row].set(int(p["status"])),
         )
+        # stalled decisions at/below the adopted watermark are covered by
+        # the transferred state; later ones can still drain normally
+        q = self._stalled.get(row)
+        if q is not None:
+            kept = collections.deque(e for e in q if e[2] > donor_exec)
+            if kept:
+                self._stalled[row] = kept
+            else:
+                del self._stalled[row]
+                self._stall_tick.pop(row, None)
         if int(p["status"]) == int(GroupStatus.STOPPED):
             self._stopped_rows.add(row)
         self._seen.pop(row, None)

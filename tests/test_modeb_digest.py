@@ -1,0 +1,142 @@
+"""Digest-only accepts (cfg.paxos.digest_accepts) over Mode B clusters.
+
+The reference cuts coordinator egress by broadcasting each request's payload
+from its ENTRY replica and sending digest-only ACCEPTs
+(paxosutil/PendingDigests.java:23; match/release
+PaxosInstanceStateMachine.java:1089-1102; undigest fetch :1257-1268).  The
+dense wire design's accept rings are rid-only already, so digest mode here
+is: rid-only proposal forwards, entry-replica payload broadcast on frames,
+and an execution-side stall + undigest fetch for a committed rid whose
+payload has not arrived.
+"""
+
+import time
+
+from test_modeb import IDS, Cluster, make_cfg
+
+
+def _digest_cfg(groups=16):
+    cfg = make_cfg(groups=groups)
+    cfg.paxos.digest_accepts = True
+    return cfg
+
+
+def test_digest_commit_correctness_all_entries():
+    """Commits succeed and replicas converge with the flag on, from every
+    entry node (coordinator and non-coordinator alike)."""
+    cl = Cluster(_digest_cfg())
+    try:
+        cl.create("svc")
+        for i, nid in enumerate(IDS * 2):
+            resp = cl.commit(nid, "svc", f"PUT k{i} v{i}".encode())
+            assert resp == b"OK", (nid, resp)
+        cl.ticks(20)
+        dbs = [cl.apps[nid].db.get("svc", {}) for nid in IDS]
+        assert dbs[0] == dbs[1] == dbs[2]
+        assert len(dbs[0]) == 6
+    finally:
+        cl.close()
+
+
+def test_digest_cuts_coordinator_frame_bytes():
+    """With KB payloads entering at a NON-coordinator node, the
+    coordinator's frame bytes drop materially: payload dissemination moved
+    from the coordinator's broadcast to the entry replica's."""
+    payload = b"PUT big " + b"x" * 4096
+    byte_counts = {}
+    for digest in (False, True):
+        cfg = make_cfg()
+        cfg.paxos.digest_accepts = digest
+        cl = Cluster(cfg)
+        try:
+            cl.create("svc")
+            cl.ticks(5)  # settle coordinator election (slot 0 = N0)
+            for n in cl.nodes.values():
+                n.stats["frame_bytes_sent"] = 0
+            for i in range(12):
+                assert cl.commit("N1", "svc", payload) == b"OK"
+            cl.ticks(5)
+            byte_counts[digest] = cl.nodes["N0"].stats["frame_bytes_sent"]
+            # correctness unchanged
+            assert cl.apps["N0"].db["svc"]["big"] == "x" * 4096
+        finally:
+            cl.close()
+    # coordinator egress must drop by at least the payload volume
+    assert byte_counts[True] < byte_counts[False] - 10 * len(payload), (
+        byte_counts
+    )
+
+
+def test_undigest_fetch_recovers_suppressed_broadcast():
+    """A replica that learns a commit before the payload stalls its row and
+    fetches the payload from the rid's origin (the undigest request,
+    PaxosInstanceStateMachine.java:1257-1268) — no taint, no divergence."""
+    cl = Cluster(_digest_cfg())
+    try:
+        cl.create("svc")
+        cl.ticks(5)
+        entry = cl.nodes["N1"]
+        # sabotage the entry broadcast: drop the staged extra payloads
+        # INSIDE the tick, before the frame build — no peer ever receives
+        # the payload on frames, so only undigest can recover
+        orig_build = entry._build_frames
+
+        def sabotaged_build():
+            entry._extra_pay.clear()
+            return orig_build()
+
+        entry._build_frames = sabotaged_build
+        done = []
+        rid = entry.propose("svc", b"PUT k lost",
+                            lambda _r, resp: done.append(resp))
+        assert rid is not None
+        for _ in range(200):
+            cl.ticks(1)
+            if done and all(
+                cl.apps[nid].db.get("svc", {}).get("k") == "lost"
+                for nid in IDS
+            ):
+                break
+        assert done and done[0] == b"OK"
+        for nid in IDS:
+            assert cl.apps[nid].db["svc"]["k"] == "lost", nid
+            assert not cl.nodes[nid]._tainted_rows
+            assert not cl.nodes[nid]._stalled
+        fills = sum(cl.nodes[nid].stats["undigest_fills"] for nid in IDS)
+        assert fills >= 1  # at least one node resolved by fetch
+    finally:
+        cl.close()
+
+
+def test_digest_survives_crash_recovery(tmp_path):
+    """WAL replay of a digest-mode node: digest placements journal with
+    payload=None, frames/undigest fills re-learn payloads, and the
+    recovered node matches the survivors."""
+    cl = Cluster(_digest_cfg(), wal_root=tmp_path)
+    try:
+        cl.create("svc")
+        for i in range(6):
+            # alternate entry so both forward directions journal
+            assert cl.commit(IDS[i % 3], "svc",
+                             f"PUT k{i} v{i}".encode()) == b"OK"
+        cl.ticks(10)
+        expect = dict(cl.apps["N1"].db.get("svc", {}))
+        cl.kill("N1")
+        cl.drop_backlog("N1")
+        # survivors keep committing while N1 is down
+        assert cl.commit("N0", "svc", b"PUT late 1",
+                         only=("N0", "N2")) == b"OK"
+        cl.restart("N1")
+        # replay alone reproduced every pre-crash commit
+        assert dict(cl.apps["N1"].db.get("svc", {})) == expect
+        # the rejoiner catches up (including the commit it missed)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            cl.ticks(2)
+            if cl.apps["N1"].db.get("svc", {}).get("late") == "1":
+                break
+        assert cl.apps["N1"].db["svc"]["late"] == "1"
+        # and keeps serving new digest-mode commits
+        assert cl.commit("N1", "svc", b"PUT post 2") == b"OK"
+    finally:
+        cl.close()

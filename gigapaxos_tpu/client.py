@@ -305,13 +305,17 @@ class ReconfigurableAppClient:
         return list(actives)
 
     # ----------------------------------------------------------- app requests
-    def _pick_active(self, actives: List[str]) -> str:
+    def _pick_active(self, actives: List[str], avoid=()) -> str:
         """Lowest-EWMA-RTT active, with epsilon exploration so a recovered
-        replica gets re-measured (E2ELatencyAwareRedirector's probe idea)."""
-        unknown = [a for a in actives if a not in self._rtt]
+        replica gets re-measured (E2ELatencyAwareRedirector's probe idea).
+        ``avoid``: targets that already failed THIS request (e.g. answered
+        not_active while still birthing the epoch) — excluded unless that
+        empties the pool."""
+        pool = [a for a in actives if a not in avoid] or list(actives)
+        unknown = [a for a in pool if a not in self._rtt]
         if unknown or random.random() < self.explore_prob:
-            return random.choice(unknown or actives)
-        return min(actives, key=lambda a: self._rtt.get(a, float("inf")))
+            return random.choice(unknown or pool)
+        return min(pool, key=lambda a: self._rtt.get(a, float("inf")))
 
     def send_request(
         self,
@@ -421,13 +425,16 @@ class ReconfigurableAppClient:
         per = max(timeout / tries, 0.5)
         last = "timeout"
         rid = self._rid()  # one rid for every attempt (retransmission dedup)
+        bad: set = set()  # targets that failed this request (rotate away:
+        # after an epoch change one member may still be birthing the group,
+        # and RTT-greedy picking would hammer it until the budget dies)
         try:
             for attempt in range(tries):
                 try:
                     actives = self.request_actives(name, force=attempt > 0)
                 except ClientError as e:
                     raise ClientError(f"{name}: {e}") from e
-                target = self._pick_active(actives)
+                target = self._pick_active(actives, avoid=bad)
                 with self._lock:
                     self._sent_at[rid] = (target, time.monotonic())
                 self.m.send(
@@ -438,12 +445,14 @@ class ReconfigurableAppClient:
                 except TimeoutError:
                     last = f"timeout via {target}"
                     self._penalize(target, per)
+                    bad.add(target)
                     continue
                 if resp.get("ok"):
                     return pkt.b64d(resp["response"]) or b""
                 last = resp.get("error", "error")
                 if last not in ("not_active", "stopped", "busy"):
                     raise ClientError(f"{name}: {last}")
+                bad.add(target)
                 time.sleep(min(0.1 * (attempt + 1), 0.5))
             raise TimeoutError(f"{name}: {last}")
         finally:
@@ -599,14 +608,21 @@ class BatchingSender:
         if not buf:
             return
         # per-request callbacks ride the shared dispatcher; the rid->cb map
-        # fills after the send returns, so dispatch gates on `ready` (the
-        # loopback short-circuit can deliver a response before this thread
-        # runs the fill loop)
+        # fills after the send returns (the loopback short-circuit can
+        # deliver a response before this thread runs the fill loop).  Early
+        # responses are BUFFERED, never block the client's demux thread —
+        # a stalled send must not freeze unrelated responses for this
+        # client, and a slow fill must not drop callbacks.
         cbs = {}
-        ready = threading.Event()
+        early: list = []
+        filled = [False]
+        gate = threading.Lock()
 
         def dispatch(p: dict) -> None:
-            ready.wait(timeout=5)
+            with gate:
+                if not filled[0]:
+                    early.append(p)
+                    return
             cb = cbs.pop(p.get("rid"), None)
             if cb is not None:
                 cb(p)
@@ -617,8 +633,13 @@ class BatchingSender:
             rids = send([(n, pl) for n, pl, _ in buf], dispatch)
         except Exception as e:
             # resolve/send failure must not silently strand the whole
-            # buffered batch: every callback gets an error packet
-            ready.set()
+            # buffered batch: every callback gets an error packet.  Open
+            # the gate with an empty cb map — a partially-sent batch's
+            # real responses must be dropped (their callbacks just fired
+            # with the error), not buffered in `early` forever
+            with gate:
+                filled[0] = True
+                early[:] = []
             for _n, _p, cb in buf:
                 try:
                     cb({"ok": False, "error": f"{type(e).__name__}: {e}"})
@@ -627,7 +648,13 @@ class BatchingSender:
             return
         for rid, (_n, _p, cb) in zip(rids, buf):
             cbs[rid] = cb
-        ready.set()
+        with gate:
+            filled[0] = True
+            drain, early[:] = early[:], []
+        for p in drain:  # delivered on the flusher thread, in arrival order
+            cb = cbs.pop(p.get("rid"), None)
+            if cb is not None:
+                cb(p)
 
     def _run(self) -> None:
         while not self._closed:

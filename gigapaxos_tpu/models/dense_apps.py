@@ -50,10 +50,21 @@ class DenseCounterApp(Replicable):
 
     # ---- vectorized hot path ----
     def execute_rows_batch(self, rows, payloads, request_ids) -> Optional[list]:
-        blob = b"".join(payloads)
-        if len(blob) == 8 * len(rows):
-            deltas = np.frombuffer(blob, "<i8")
+        # per-payload length check, matching execute() exactly: apply iff
+        # len == 8, skip otherwise — a whole-blob length test would
+        # misattribute deltas in a mixed-size batch that sums to 8n
+        lens = np.fromiter((len(p) for p in payloads), np.int64,
+                           count=len(payloads))
+        ok = lens == 8
+        if ok.all():
+            deltas = np.frombuffer(b"".join(payloads), "<i8")
             np.add.at(self.acc, rows, deltas)
+        elif ok.any():
+            sel = np.nonzero(ok)[0]
+            deltas = np.frombuffer(
+                b"".join(payloads[i] for i in sel), "<i8"
+            )
+            np.add.at(self.acc, np.asarray(rows)[sel], deltas)
         np.add.at(self.count, rows, 1)
         return None  # no response bodies
 

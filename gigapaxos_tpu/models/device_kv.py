@@ -204,7 +204,15 @@ def _fused_compact_impl(state, kv: DeviceKVState, inbox: TickInbox,
     e_miss = jnp.zeros((exec_budget,), I32).at[idx].set(
         miss.astype(I32).reshape(-1), mode="drop"
     )
-    return new_state, kv2, jnp.concatenate([packed, e_resp, e_miss])
+    flat = jnp.concatenate([packed, e_resp, e_miss])
+    # pack/unpack agreement enforced at trace time against the shared
+    # layout descriptor (consumers slice via CompactLayout.kv_extras)
+    from ..ops.tick import CompactLayout
+
+    L = CompactLayout(R, G, exec_budget, lag_budget)
+    assert flat.shape[0] == L.total_device, (flat.shape, L.total_device)
+    assert packed.shape[0] == L.o_resp
+    return new_state, kv2, flat
 
 
 fused_compact = jax.jit(_fused_compact_impl, donate_argnums=(0, 1),

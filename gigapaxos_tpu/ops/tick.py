@@ -681,20 +681,55 @@ paxos_tick_compact = jax.jit(
 )
 
 
+class CompactLayout:
+    """THE single source of truth for the compacted-outbox flat buffer:
+    every offset any consumer needs, computed in one place.
+
+    Producers (:func:`_compact_outbox_impl` and the device-app
+    ``fused_compact``, which appends its per-execution extras) emit
+    sections in exactly this order; consumers (:func:`unpack_compact`,
+    ``PaxosManager._complete_tick``, WAL device-app replay) slice through
+    this object only — one field added to the packed buffer is one edit
+    here, not silent corruption in a hand-computed twin offset.
+
+    Section order: header[3] | taken_bits[R*G] | e_rid[E] | e_meta[E] |
+    e_slot[E] | e_row[E] | l_rep[Lb] | l_row[Lb] | app extras
+    (device-app: e_resp[E] | e_miss[E])."""
+
+    HEADER = 3  # n_exec, decided_total, lag_n
+
+    def __init__(self, R: int, G: int, exec_budget: int, lag_budget: int):
+        self.R, self.G = R, G
+        self.E, self.Lb = exec_budget, lag_budget
+        self.o_taken = self.HEADER
+        self.o_exec = self.o_taken + R * G      # 4 E-sized exec columns
+        self.o_lag = self.o_exec + 4 * self.E   # 2 Lb-sized laggard columns
+        self.base = self.o_lag + 2 * self.Lb    # app extras start here
+        self.o_resp = self.base                 # device-app: KV responses
+        self.o_miss = self.base + self.E        # device-app: descriptor miss
+        self.total_plain = self.base
+        self.total_device = self.base + 2 * self.E
+
+    def kv_extras(self, flat):
+        """Device-app extras aligned with the exec stream: (e_resp, e_miss)."""
+        return (flat[self.o_resp:self.o_resp + self.E],
+                flat[self.o_miss:self.o_miss + self.E])
+
+
 def unpack_compact(flat, R: int, G: int, exec_budget: int,
                    lag_budget: int) -> CompactHostOutbox:
     """Host-side inverse of :func:`_compact_outbox_impl` (zero-copy views
     into the one transferred buffer)."""
     flat = np.asarray(flat)
-    E, Lb = exec_budget, lag_budget
+    L = CompactLayout(R, G, exec_budget, lag_budget)
+    E, Lb = L.E, L.Lb
     n_exec, decided_total, lag_n = (int(flat[0]), int(flat[1]), int(flat[2]))
-    o = 3
-    taken_bits = flat[o:o + R * G].reshape(R, G)
-    o += R * G
+    o = L.o_exec
     e_rid = flat[o:o + n_exec]; o += E
     e_meta = flat[o:o + n_exec]; o += E
     e_slot = flat[o:o + n_exec]; o += E
     e_row = flat[o:o + n_exec]; o += E
+    assert o == L.o_lag
     ln = min(lag_n, Lb)
     l_rep = flat[o:o + ln]; o += Lb
     l_row = flat[o:o + ln]
@@ -702,7 +737,7 @@ def unpack_compact(flat, R: int, G: int, exec_budget: int,
         n_exec=n_exec,
         decided_total=decided_total,
         lag_n=lag_n,
-        taken_bits=taken_bits,
+        taken_bits=flat[L.o_taken:L.o_taken + R * G].reshape(R, G),
         e_rid=e_rid,
         e_rep=e_meta & 0xFF,
         e_row=e_row,

@@ -136,6 +136,11 @@ class PaxosManager:
         self._use_compact = bool(cfg.paxos.compact_outbox)
         self._exec_budget = cfg.paxos.exec_budget or max(4096, 2 * self.G)
         self._lag_budget = max(64, cfg.paxos.lag_budget)
+        from ..ops.tick import CompactLayout
+
+        self._compact_layout = CompactLayout(
+            self.R, self.G, self._exec_budget, self._lag_budget
+        )
         bc = cfg.paxos.bulk_capacity or max(1 << 16, 4 * self.G)
         self._bulk_cap = 1 << (bc - 1).bit_length()
         self.bulk: Optional[BulkStore] = None  # lazy (most managers: unused)
@@ -1076,10 +1081,9 @@ class PaxosManager:
                                  self._exec_budget, self._lag_budget)
             e_resp = e_miss = None
             if self._device_app:
-                E = self._exec_budget
-                base = 3 + self.R * self.G + 4 * E + 2 * self._lag_budget
-                e_resp = flat[base:base + E]
-                e_miss = flat[base + E:base + 2 * E]
+                # extras sliced through the shared layout descriptor —
+                # fused_compact packs them through the same object
+                e_resp, e_miss = self._compact_layout.kv_extras(flat)
             self._process_compact(out, placed, bulk_placed, e_resp, e_miss)
         else:
             out = (packed if isinstance(packed, HostOutbox)
@@ -1204,12 +1208,28 @@ class PaxosManager:
         if s.slot[sidx] < 0:
             s.slot[sidx] = slot
         name = self._row_name_np[row]
-        resp = self.apps[r].execute(name, s.payload[sidx], rid)
-        self.stats["executions"] += 1
+        payload = s.payload[sidx]
+        desc_lost = False
+        if self._device_app and len(payload or b"") == 0:
+            # device-app store requests carry no host payload (the
+            # descriptor lives in the device table); reaching the scalar
+            # path with nothing to re-apply means the descriptor was lost
+            # (sizing invariant violated).  Fail the request explicitly —
+            # executing b"" would no-op into a silently-lost update
+            # reported as an empty success.
+            desc_lost = True
+            resp = None
+        else:
+            resp = self.apps[r].execute(name, payload, rid)
+            self.stats["executions"] += 1
         if s.entry[sidx] == r and not s.responded[sidx]:
             s.responded[sidx] = True
             s.response[sidx] = resp
-            if self._bulk_cbs:
+            if desc_lost:
+                self.stats["failed_requests"] += 1
+                if self._bulk_cbs:
+                    self._bulk_fire([rid])  # cb(None): client-visible failure
+            elif self._bulk_cbs:
                 self._bulk_fire([rid], [resp if resp is not None else b""])
         full = self._member_bits[row]
         if s.responded[sidx] and (s.exec_mask[sidx] & full) == full:
@@ -1342,7 +1362,13 @@ class PaxosManager:
                 store.free_done(ti, self._member_bits[store.row[ti]])
         self.stats["decisions"] += co.decided_total
         self._lag_pending = (co.l_rep.copy(), co.l_row.copy())
-        if self.cfg.paxos.auto_laggard_sync and co.lag_n:
+        # During journal replay (_replay_process installed) laggard repair
+        # must come ONLY from journaled OP_SYNC records: the live run's
+        # donor choice may have been constrained by liveness that replay
+        # (alive all-True by default) cannot see, and a replay-chosen donor
+        # would restore a different checkpoint/watermark than the crash run.
+        if (self.cfg.paxos.auto_laggard_sync and co.lag_n
+                and getattr(self, "_replay_process", None) is None):
             # self-heal: a replica >= W behind can never catch up by ring
             # sync — its missed slots have rotated out of every decision
             # ring.  The budget's fair ordering prevents self-inflicted

@@ -505,9 +505,9 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
             )
             flat = np.asarray(packed)
             co = unpack_compact(flat, m.R, m.G, E, Lb)
-            base = 3 + m.R * m.G + 4 * E + 2 * Lb
-            return state, (co, flat[base:base + E],
-                           flat[base + E:base + 2 * E])
+            # extras sliced via the shared layout descriptor, same as the
+            # live path (manager._complete_tick)
+            return state, (co, *m._compact_layout.kv_extras(flat))
 
         def _proc(out, bulk_placed):
             co, er, em = out

@@ -287,3 +287,33 @@ def test_bulk_wal_recovery_mid_snapshot(tmp_path):
     # against a fixed winner)
     assert apps2[0].db["g0"] == live
     assert apps2[1].db["g0"] == live
+
+
+def test_dense_counter_batch_matches_scalar_mixed_sizes():
+    """Batch==sequential determinism for DenseCounterApp under payloads of
+    mixed sizes: apply iff len==8 per request, exactly like execute()."""
+    import struct
+
+    import numpy as np
+
+    from gigapaxos_tpu.models.dense_apps import DenseCounterApp
+
+    rows = np.array([0, 1, 2, 3, 1], np.int64)
+    # 4+12=16 bytes happens to equal 8*2 for the first two — the
+    # whole-blob-length bug would misattribute these
+    payloads = np.empty(5, object)
+    payloads[:] = [b"abcd", b"0123456789ab", struct.pack("<q", 7),
+                   b"", struct.pack("<q", -3)]
+    a = DenseCounterApp(8, row_of=lambda n: int(n))
+    a.execute_rows_batch(rows, payloads, np.arange(5))
+    b = DenseCounterApp(8, row_of=lambda n: int(n))
+    for r, p, rid in zip(rows, payloads, range(5)):
+        b.execute(str(int(r)), p, rid)
+    assert (a.acc == b.acc).all(), (a.acc, b.acc)
+    assert (a.count == b.count).all()
+
+    # all-valid fast path still vectorizes correctly
+    payloads2 = np.empty(3, object)
+    payloads2[:] = [struct.pack("<q", v) for v in (1, 2, 3)]
+    a.execute_rows_batch(np.array([5, 5, 6]), payloads2, np.arange(3))
+    assert a.acc[5] == 3 and a.acc[6] == 3

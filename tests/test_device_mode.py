@@ -214,3 +214,92 @@ def test_device_cluster_reconfiguration_e2e():
     finally:
         client.close()
         cluster.close()
+
+
+def test_descriptor_miss_fails_request_explicitly():
+    """A committed rid whose descriptor is unrecoverable (device-table
+    eviction under a violated sizing invariant) must FAIL the request
+    (cb(None), failed_requests counted) — never an empty success that
+    silently loses the update (ADVICE r4)."""
+    m, _ = mk(G=8)
+    assert m.create_paxos_instance("d0", [0, 1, 2])
+    row = m.rows.row("d0")
+    store = m._ensure_bulk()
+    rid = 424242
+    pay = np.empty(1, object)
+    pay[:] = [b""]  # device-app store requests carry no host payload
+    store.admit_at(np.array([rid], np.int64), np.array([row], np.int32),
+                   np.array([0], np.int32), np.array([False]), pay)
+    got = {}
+    m._bulk_cbs[rid] = lambda r_, resp: got.setdefault("resp", resp)
+    sidx = rid & store.mask
+    before = m.stats["failed_requests"]
+    for r in range(3):
+        m._store_exec_one(r, row, rid, 5 + r, sidx)
+    # entry replica 0 saw the lost descriptor: explicit failure, not b""
+    assert m.stats["failed_requests"] == before + 1
+    for cb, rid_, resp in list(m._held_callbacks):
+        cb(rid_, resp)
+    assert got.get("resp", b"MISSING") is None
+
+
+def test_compact_layout_single_source_of_truth():
+    """Pack (device fused program) and unpack (host) agree through the one
+    CompactLayout descriptor: buffer sizes match the descriptor exactly and
+    a real commit's response surfaces through kv_extras at the documented
+    offsets (VERDICT r4 weak #7)."""
+    import jax.numpy as jnp
+
+    from gigapaxos_tpu.models.device_kv import (OP_PUT, fused_compact,
+                                                init_kv, register_requests)
+    from gigapaxos_tpu.ops.tick import (CompactLayout, TickInbox,
+                                        paxos_tick_compact, unpack_compact)
+    from gigapaxos_tpu.paxos import state as st
+
+    R, G, W, E, Lb = 3, 8, 8, 64, 64
+    L = CompactLayout(R, G, E, Lb)
+    assert L.o_taken == 3
+    assert L.o_exec == 3 + R * G
+    assert L.o_lag == L.o_exec + 4 * E
+    assert L.o_resp == L.o_lag + 2 * Lb
+    assert L.o_miss == L.o_resp + E
+
+    s = st.create_groups(st.init_state(R, G, W),
+                         np.arange(G, dtype=np.int32), np.ones((G, R), bool))
+    # plain compact buffer: exactly total_plain
+    req = np.zeros((R, 2, G), np.int32)
+    req[0, 0, 0] = 77
+    inbox = TickInbox(jnp.asarray(req), jnp.zeros((R, 2, G), bool),
+                      jnp.ones(R, bool))
+    s2, packed = paxos_tick_compact(s, inbox, -1, E, Lb)
+    assert np.asarray(packed).shape[0] == L.total_plain
+
+    # device-app buffer: total_device, and the response round-trips
+    kv = init_kv(R, G, slots=8, table=1 << 16)
+    kv = register_requests(kv, jnp.asarray([77], jnp.int32),
+                           jnp.asarray([OP_PUT], jnp.int32),
+                           jnp.asarray([3], jnp.int32),
+                           jnp.asarray([1234], jnp.int32))
+    state = st.create_groups(st.init_state(R, G, W),
+                             np.arange(G, dtype=np.int32),
+                             np.ones((G, R), bool))
+    zeros = np.zeros(4, np.int32)
+    flat = None
+    for _ in range(4):  # propose -> accept -> decide -> execute
+        state, kv, packed = fused_compact(
+            state, kv, inbox, zeros, zeros, zeros, zeros, -1, E, Lb)
+        inbox = TickInbox(jnp.zeros((R, 2, G), jnp.int32),
+                          jnp.zeros((R, 2, G), bool), jnp.ones(R, bool))
+        flat = np.asarray(packed)
+        co = unpack_compact(flat, R, G, E, Lb)
+        if co.n_exec:
+            break
+    assert flat.shape[0] == L.total_device
+    co = unpack_compact(flat, R, G, E, Lb)
+    assert co.n_exec >= 1
+    e_resp, e_miss = L.kv_extras(flat)
+    execd = co.e_rid[:co.n_exec] == 77
+    assert execd.any()
+    # PUT echoes the stored value through the layout's response column
+    assert (e_resp[:co.n_exec][execd] == 1234).all()
+    assert (e_miss[:co.n_exec][execd] == 0).all()

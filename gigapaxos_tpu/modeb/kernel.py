@@ -126,6 +126,66 @@ def unpack_node_tick(flat, R: int, P: int, W: int, G: int):
 
 
 @functools.lru_cache(maxsize=None)
+def node_tick_device(r: int, K: int):
+    """Jitted per-node step with the device KV app fused behind it (the
+    Mode-B twin of models/device_kv.fused_compact): descriptor upload +
+    consensus tick + own-row on-device execution in ONE program.
+
+    The node's kv has replica-axis 1 (it executes only its own row).  Rows
+    with ANY descriptor miss this tick — or held by the host (``hold``:
+    rows whose execution stream is stalled on an unarrived payload) — are
+    SUPPRESSED on device: no kv write at all, because applying slot j+1
+    while slot j is missing/stalled would break RSM order.  The host
+    re-applies a suppressed row's batch in order through the scalar
+    fallback (reusing the digest stall machinery).  reg_*: up to K new
+    descriptors (rid 0 = empty).
+
+    Returns (state', kv', flat) with flat = pack_outbox ++ changed[G] ++
+    resp[W*G] ++ row_skip[G] (own row, window-major).
+    """
+    from ..models.device_kv import kv_apply, register_requests
+    from ..ops.tick import pack_outbox_impl
+
+    def impl(state, kv, inbox, reg_rids, reg_ops, reg_keys, reg_vals, hold):
+        kv = register_requests(kv, reg_rids, reg_ops, reg_keys, reg_vals,
+                               mix=True)
+        new, out, changed = node_tick_impl(state, inbox, r)
+        er = out.exec_req[r:r + 1]      # [1, W, G]
+        ec = out.exec_count[r:r + 1]
+        kv2, resp, miss = kv_apply(kv, er, ec, mix=True)
+        row_skip = jnp.any(miss[0], axis=0) | hold  # [G]
+        # suppress every kv effect of a skipped row (host replays in order)
+        keep = ~row_skip[None, :, None]
+        kv2 = kv2._replace(
+            key=jnp.where(keep, kv2.key, kv.key),
+            val=jnp.where(keep, kv2.val, kv.val),
+        )
+        flat = jnp.concatenate([
+            pack_outbox_impl(out), changed.astype(jnp.int32),
+            resp[0].reshape(-1), row_skip.astype(jnp.int32),
+        ])
+        return new, kv2, flat
+
+    return jax.jit(impl, donate_argnums=(0, 1))
+
+
+def unpack_node_tick_device(flat, R: int, P: int, W: int, G: int):
+    """Host inverse of :func:`node_tick_device`: -> (outbox, changed[G],
+    resp[W, G], row_skip[G])."""
+    import numpy as np
+
+    from ..ops.tick import unpack_outbox
+
+    flat = np.asarray(flat)
+    tail = G + W * G + G
+    out = unpack_outbox(flat[:-tail], R, P, W, G)
+    changed = flat[-tail:-tail + G].astype(bool)
+    resp = flat[-tail + G:-G].reshape(W, G)
+    row_skip = flat[-G:].astype(bool)
+    return out, changed, resp, row_skip
+
+
+@functools.lru_cache(maxsize=None)
 def frame_extract(r: int, K: int):
     """Jitted own-row gather for frame building: selects ``K`` rows of every
     frame field in one device program and returns one flat i32 buffer

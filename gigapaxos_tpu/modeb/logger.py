@@ -219,10 +219,15 @@ class ModeBLogger(PaxosLogger):
             # the spilled records and their app projections (the journal
             # holding their OP_CREATE gets GC'd)
             "paused": self._paused_snapshot(m),
-            "app": {
+            # device-app nodes snapshot the device arrays verbatim (dkv_*
+            # in the npz, written by the base checkpoint()); a per-name
+            # projection would be redundant and lossy (key 0 sentinel)
+            "app": ({
                 name: m.app.checkpoint(name)
                 for name in list(m.rows.names()) + list(m._paused)
-            },
+            } if not getattr(m, "_device_app", False) else None),
+            "kv_pending": (list(getattr(m, "_kv_pending", ()))
+                           if getattr(m, "_device_app", False) else None),
         }
 
 
@@ -260,6 +265,16 @@ def recover_modeb(cfg, member_ids, node_id, app, log_dir: str,
         node.state = PaxosState(
             **{f: jnp.asarray(arrs[f]) for f in PaxosState._fields}
         )
+        if getattr(node, "_device_app", False):
+            if any(k.startswith("dkv_") for k in arrs.files):
+                from ..models.device_kv import DeviceKVState
+
+                node.kv = DeviceKVState(**{
+                    f: jnp.asarray(arrs["dkv_" + f])
+                    for f in DeviceKVState._fields
+                })
+            for item in meta.get("kv_pending") or ():
+                node._kv_pending.append(tuple(item))
         node.tick_num = meta["tick_num"]
         node._next_seq = meta["next_seq"]
         node.rows.restore(meta["rows"], meta["free_rows"])
@@ -295,7 +310,7 @@ def recover_modeb(cfg, member_ids, node_id, app, log_dir: str,
         node._frame_applied_tick = dict(meta["frame_applied"])
         node._paused.update(meta.get("paused", {}))
         node._paused_gids = {wire.gid_of(n): n for n in node._paused}
-        for name, blob in meta["app"].items():
+        for name, blob in (meta["app"] or {}).items():
             node.app.restore(name, blob)
         start_seq = snap_seq
 
@@ -310,14 +325,37 @@ def recover_modeb(cfg, member_ids, node_id, app, log_dir: str,
     def run_tick(bufs, alive):
         inbox = TickInbox(jnp.asarray(bufs[0]), jnp.asarray(bufs[1]),
                           jnp.asarray(alive))
+        if getattr(node, "_device_app", False):
+            hold = np.zeros(node.G, bool)
+            if node._stalled:
+                hold[list(node._stalled)] = True
+            node.state, node.kv, packed = node._tick_device(
+                node.state, node.kv, inbox, *node._take_kv_reg(), hold,
+            )
+            out, changed, extras = node._unpack_tick(packed)
+            node._replay_extras = extras
+            return out, changed
         node.state, packed = node._tick_packed(node.state, inbox)
         return unpack_node_tick(packed, node.R, node.P, node.W, node.G)
+
+    if getattr(node, "_device_app", False):
+        # route the replay's outbox processing through the device extras
+        # exactly like the live tick (fast path per non-skipped row)
+        _orig_process = node._process_outbox
+
+        def _proc(out, placed=None, extras=None):
+            _orig_process(out, placed,
+                          node.__dict__.pop("_replay_extras", None))
+
+        node._process_outbox = _proc
 
     replay_node_journals(
         node, log_dir, start_seq,
         stage=lambda raw: node._apply_frame(wire.decode_frame(raw)),
         new_buffers=new_buffers, place=place, run_tick=run_tick,
     )
+    if "_process_outbox" in node.__dict__:
+        del node._process_outbox
 
     node._flush_mirrors()  # frames journaled after the last tick record
     node._held_callbacks = []  # no live clients to answer during replay

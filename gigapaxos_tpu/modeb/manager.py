@@ -47,13 +47,15 @@ from ..utils.locking import ContendedLock
 from ..utils.reqtrace import tracer as _reqtrace
 from ..paxos import state as st
 from . import wire
-from .kernel import (frame_extract, mirror_apply, node_tick_packed,
-                     unpack_frame_extract, unpack_node_tick)
+from .kernel import (frame_extract, mirror_apply, node_tick_device,
+                     node_tick_packed, unpack_frame_extract,
+                     unpack_node_tick, unpack_node_tick_device)
 
 #: request ids are node-scoped: high bits carry the origin replica slot so
 #: any node can route the response duty without a lookup (the entry-replica
 #: field of RequestPacket, gigapaxos/paxospackets/RequestPacket.java:189)
 from .common import RID_MASK, RID_SHIFT, ModeBCommon, rid_origin  # noqa: E402,F401
+from ..models.device_kv import DESC as _DESC, DESC_LEN as _DESC_LEN
 
 MB_PROPOSAL = "mb_proposal"
 MB_UNDIGEST = "mb_undigest"
@@ -195,6 +197,32 @@ class ModeBNode(ModeBCommon):
         self._last_frame_rx = 0  # our tick count when a frame last arrived
         self.stats = collections.Counter()
         self.lock = ContendedLock()
+        # ---- device-resident application (models/device_kv.py) ----
+        # The per-process deployment twin of Mode A's device_app
+        # (PaxosManager.java:108-111 deployment shape): this node owns a
+        # 1-replica-axis DeviceKVState; decisions of its OWN row execute
+        # on device inside the fused node tick.
+        self._device_app = bool(cfg.paxos.device_app)
+        self.kv = None
+        if self._device_app:
+            from ..models.device_kv import DeviceKVApp, init_kv
+
+            table = cfg.paxos.kv_table or (
+                1 << max(16, (4 * self.G - 1).bit_length())
+            )
+            self.kv = init_kv(1, self.G, cfg.paxos.kv_slots, table)
+            self.app = DeviceKVApp(self, 0, row_of=self.rows.row)
+            self._kv_reg_budget = cfg.paxos.kv_reg_budget or max(
+                256, 2 * self.P * 16
+            )
+            #: parsed descriptors awaiting upload: (rid, op, key, val)
+            self._kv_pending: collections.deque = collections.deque()
+            self._kv_known: "collections.OrderedDict[int, bool]" = (
+                collections.OrderedDict()
+            )
+            self._tick_device = node_tick_device(
+                self.r, self._kv_reg_budget
+            )
         self._tick_packed = node_tick_packed(self.r)
         # preallocated inbox staging (entries cleared lazily next build)
         self._in_req = np.zeros((self.R, self.P, self.G), np.int32)
@@ -324,6 +352,7 @@ class ModeBNode(ModeBCommon):
                     rec.responded = True
                     self._held_callbacks.append((rec.callback, rid, None))
             self.state = st.free_groups(self.state, np.array([row], np.int32))
+            self._kv_clear_rows([row])
             self.rows.free(name)
             self._gid_row.pop(wire.gid_of(name), None)
             self._row_meta.pop(row, None)
@@ -387,6 +416,16 @@ class ModeBNode(ModeBCommon):
                 self.wal.log_pause(names)
         return len(names)
 
+    def _kv_clear_rows(self, rows) -> None:
+        """Scrub device-app KV rows on free: a recycled row must not leak
+        the previous occupant's keys to the next group."""
+        if self.kv is not None and len(rows):
+            r = np.asarray(rows, np.int32)
+            self.kv = self.kv._replace(
+                key=self.kv.key.at[:, r].set(0),
+                val=self.kv.val.at[:, r].set(0),
+            )
+
     def _do_pause(self, names) -> None:
         """Spill exactly ``names`` (also the WAL replay entry point — must
         mirror the live run's choice so row allocation stays in lockstep)."""
@@ -395,14 +434,19 @@ class ModeBNode(ModeBCommon):
             row = self.rows.row(name)
             hri = st.extract_hri(self.state, row)
             hri["stopped"] = row in self._stopped_rows
-            self._paused[name] = {"hri": hri,
-                                  "meta": self._row_meta[row]}
+            rec = {"hri": hri, "meta": self._row_meta[row]}
+            if self.kv is not None:
+                # device-app state is keyed by ROW — ride the spilled record
+                rec["dkv_key"] = np.asarray(self.kv.key[0, row])
+                rec["dkv_val"] = np.asarray(self.kv.val[0, row])
+            self._paused[name] = rec
             gid = wire.gid_of(name)
             self._paused_gids[gid] = name
             self._gid_row.pop(gid, None)
             rows_to_free.append(row)
         self.state = st.free_groups(self.state,
                                     np.array(rows_to_free, np.int32))
+        self._kv_clear_rows(rows_to_free)
         for name, row in zip(names, rows_to_free):
             self.rows.free(name)
             self._row_meta.pop(row, None)
@@ -434,6 +478,13 @@ class ModeBNode(ModeBCommon):
             np.array([hri["epoch"]], np.int32),
         )
         self.state = st.hot_restore(self.state, row, hri)
+        if self.kv is not None and "dkv_key" in rec:
+            import jax.numpy as _jnp
+
+            self.kv = self.kv._replace(
+                key=self.kv.key.at[0, row].set(_jnp.asarray(rec["dkv_key"])),
+                val=self.kv.val.at[0, row].set(_jnp.asarray(rec["dkv_val"])),
+            )
         gid = wire.gid_of(name)
         del self._paused[name]
         self._paused_gids.pop(gid, None)
@@ -545,6 +596,8 @@ class ModeBNode(ModeBCommon):
             rec = ModeBRecord(rid, name, row, payload, stop, callback,
                               self.tick_num)
             self.outstanding[rid] = rec
+            if self._device_app:
+                self._kv_note(rid, payload)
             self._route(rec)
 
     def propose_stop(self, name: str, payload: bytes = b"", callback=None):
@@ -630,17 +683,63 @@ class ModeBNode(ModeBCommon):
         while len(self._digest_meta) > self._payload_cap:
             self._digest_meta.popitem(last=False)
 
+    # ------------------------------------------------- device-app descriptors
+    def _store_payload(self, rid: int, payload: bytes, stop: bool) -> None:
+        super()._store_payload(rid, payload, stop)
+        if self._device_app:
+            self._kv_note(rid, payload)
+
+    def _kv_note(self, rid: int, payload: bytes) -> None:
+        """Stage a request descriptor for upload inside the next fused tick
+        (every payload choke point funnels here: own proposes, forwards,
+        frame payload items, undigest fills, journal replay)."""
+        if len(payload) != _DESC_LEN or rid in self._kv_known:
+            return
+        self._kv_known[rid] = True
+        while len(self._kv_known) > self._payload_cap:
+            self._kv_known.popitem(last=False)
+        op, k, v = struct.unpack(_DESC, payload)
+        self._kv_pending.append((rid, op, k, v))
+
+    def _take_kv_reg(self):
+        """Up to kv_reg_budget staged descriptors as fixed-size arrays
+        (rid 0 = empty slot; leftovers stay queued)."""
+        K = self._kv_reg_budget
+        arrs = [np.zeros(K, np.int32) for _ in range(4)]
+        n = min(K, len(self._kv_pending))
+        for i in range(n):
+            rid, op, k, v = self._kv_pending.popleft()
+            arrs[0][i], arrs[1][i], arrs[2][i], arrs[3][i] = rid, op, k, v
+        return arrs
+
     # ------------------------------------------------------------------- tick
     def tick(self):
         with self.lock:
             self._refresh_alive()
             self._flush_mirrors()
+            if self._device_app and self._pending_out is not None:
+                # complete the previous outbox BEFORE building this tick's
+                # hold mask: a stall it discovers must suppress THIS device
+                # step (pipelined hold built from stale _stalled would let
+                # the device apply slot j+1 while slot j is payload-stalled)
+                p = self._pending_out
+                self._pending_out = None
+                self._complete_tick(*p)
             inbox = self._build_inbox()
             placed = self._placed
             # dispatch first, journal second: the WAL append+fsync overlaps
             # the async device step (BatchedLogger overlap, SURVEY §2.2
             # item 3); responses stay held until is_synced()
-            self.state, packed = self._tick_packed(self.state, inbox)
+            if self._device_app:
+                hold = np.zeros(self.G, bool)
+                if self._stalled:
+                    hold[list(self._stalled)] = True
+                self.state, self.kv, packed = self._tick_device(
+                    self.state, self.kv, inbox, *self._take_kv_reg(),
+                    hold,
+                )
+            else:
+                self.state, packed = self._tick_packed(self.state, inbox)
             if self.wal is not None:
                 self.wal.log_inbox(self.tick_num, inbox)
             self.tick_num += 1
@@ -648,25 +747,21 @@ class ModeBNode(ModeBCommon):
                 # stage-3 overlap: execute the PREVIOUS tick's decision
                 # stream while the device computes this one
                 if self._pending_out is not None:
-                    p_out, p_placed = self._pending_out
+                    p_out, p_placed, p_extras = self._pending_out
                     self._pending_out = None  # callbacks may re-enter a
                     # drain path; never double-process
-                    self._complete_tick(p_out, p_placed)
-                out, changed = unpack_node_tick(
-                    packed, self.R, self.P, self.W, self.G
-                )
-                self._pending_out = (out, placed)
+                    self._complete_tick(p_out, p_placed, p_extras)
+                out, changed, extras = self._unpack_tick(packed)
+                self._pending_out = (out, placed, extras)
                 self._dirty |= changed
                 if self.wal is not None and self.wal.checkpoint_due():
                     # the snapshot's host metadata must cover every tick the
                     # device state contains — drain the one-tick pipeline
                     self.drain_pipeline()
             else:
-                out, changed = unpack_node_tick(
-                    packed, self.R, self.P, self.W, self.G
-                )
+                out, changed, extras = self._unpack_tick(packed)
                 self._dirty |= changed
-                self._complete_tick(out, placed)
+                self._complete_tick(out, placed, extras)
             if (self.cfg.paxos.deactivation_ticks > 0
                     and self.tick_num % 256 == 0 and len(self.rows) > 0):
                 self.pause_idle()
@@ -746,10 +841,23 @@ class ModeBNode(ModeBCommon):
         # build; zero-copy dispatch aliasing them would race the async step)
         return TickInbox(req.copy(), stp.copy(), self.alive.copy())
 
-    def _complete_tick(self, out, placed: list) -> None:
+    def _unpack_tick(self, packed):
+        """-> (outbox, changed, extras) where extras is None (host app) or
+        (resp[W, G], row_skip[G]) from the fused device-app tick."""
+        if self._device_app:
+            out, changed, resp, row_skip = unpack_node_tick_device(
+                packed, self.R, self.P, self.W, self.G
+            )
+            return out, changed, (resp, row_skip)
+        out, changed = unpack_node_tick(
+            packed, self.R, self.P, self.W, self.G
+        )
+        return out, changed, None
+
+    def _complete_tick(self, out, placed: list, extras=None) -> None:
         """Consume one tick's outbox: requeue rejected intake, execute the
         decision stream, release durable callbacks, periodic repair/GC."""
-        self._process_outbox(out, placed)
+        self._process_outbox(out, placed, extras)
         self._drain_stalled()
         self._flush_callbacks()
         if self.tick_num % 16 == 0 or self._tainted_rows:
@@ -761,11 +869,11 @@ class ModeBNode(ModeBCommon):
         """Synchronously finish the pending pipelined outbox."""
         with self.lock:
             if self._pending_out is not None:
-                p_out, p_placed = self._pending_out
+                p_out, p_placed, p_extras = self._pending_out
                 self._pending_out = None
-                self._complete_tick(p_out, p_placed)
+                self._complete_tick(p_out, p_placed, p_extras)
 
-    def _process_outbox(self, out, placed=None) -> None:
+    def _process_outbox(self, out, placed=None, extras=None) -> None:
         self._coord_view = out.coord_id
         taken = out.intake_taken[self.r]  # [P, G]
         for row, take in (self._placed if placed is None else placed):
@@ -779,26 +887,43 @@ class ModeBNode(ModeBCommon):
         es = out.exec_stop[self.r]
         eb = out.exec_base[self.r]     # [G]
         ec = out.exec_count[self.r]    # [G]
+        resp = row_skip = None
+        if extras is not None:
+            resp, row_skip = extras
         for row in np.nonzero(ec)[0]:
             name = self.rows.name(int(row))
             if name is None:
                 continue
+            # device fast path: this row's decisions executed ON DEVICE
+            # inside the fused tick (no miss, no hold) — only response /
+            # dedup / stop bookkeeping runs host-side.  Skipped rows (any
+            # descriptor miss, or stalled) had NO device effect and route
+            # through the scalar _execute_one path in ring order.
+            fast = (resp is not None and not row_skip[row]
+                    and int(row) not in self._stalled)
             for j in range(int(ec[row])):
+                r_bytes = None
+                if fast and er[j, row] != NO_REQUEST:
+                    r_bytes = struct.pack("<i", int(resp[j, row]))
                 self._execute_one(int(row), name, int(er[j, row]),
-                                  int(eb[row]) + j, bool(es[j, row]))
+                                  int(eb[row]) + j, bool(es[j, row]),
+                                  response=r_bytes)
         self.stats["decisions"] += int(np.asarray(out.decided_now).sum())
 
     def _execute_one(self, row: int, name: str, rid: int, slot: int,
-                     is_stop: bool) -> None:
+                     is_stop: bool, response: Optional[bytes] = None) -> None:
         if row in self._stalled:
             # an earlier slot of this row is waiting on its payload: every
             # later decision buffers behind it — RSM order is absolute
+            # (device-app fast-path rows never reach here: the tick's hold
+            # mask suppressed their on-device execution)
             self._stalled[row].append((name, rid, slot, is_stop))
             return
-        self._execute_direct(row, name, rid, slot, is_stop)
+        self._execute_direct(row, name, rid, slot, is_stop, response)
 
     def _execute_direct(self, row: int, name: str, rid: int, slot: int,
-                        is_stop: bool) -> None:
+                        is_stop: bool,
+                        response: Optional[bytes] = None) -> None:
         self._row_last_active[row] = self.tick_num
         if is_stop and row not in self._stopped_rows:
             self._stopped_rows.add(row)
@@ -819,11 +944,25 @@ class ModeBNode(ModeBCommon):
         while len(seen) > self._seen_cap:
             seen.popitem(last=False)
         rec = self.outstanding.get(rid)
+        if response is not None:
+            # device-app fast path: the decision already executed ON DEVICE
+            # inside the fused tick; only the response surfaces here
+            self.stats["executions"] += 1
+            if self.reqtrace.enabled:
+                self.reqtrace.event(rid, "executed", slot=slot,
+                                    node=self.node_id)
+            if rec is not None and not rec.responded:
+                rec.responded = True
+                if rec.callback is not None:
+                    self._held_callbacks.append((rec.callback, rid, response))
+                if self.reqtrace.enabled:
+                    self.reqtrace.event(rid, "responded", node=self.node_id)
+            return
         if rec is not None:
             payload, _ = rec.payload, rec.stop
         elif rid in self.payloads:
             payload = self.payloads[rid][0]
-        elif self._digest_accepts:
+        elif self._digest_accepts or self._device_app:
             # digest mode: a decision routinely commits before its payload
             # arrives — HOLD this row's execution stream and fetch the
             # payload (the PendingDigests match/undigest protocol,
@@ -1172,6 +1311,12 @@ class ModeBNode(ModeBCommon):
             row = self._gid_row.get(gid)
             if row is None or row in self._tainted_rows:
                 return  # never donate a diverged copy
+            if row in self._stalled:
+                # a stalled row's app state EXCLUDES its stalled slots while
+                # its exec watermark includes them — donating would make the
+                # receiver skip those slots forever; let a caught-up peer
+                # donate instead (or this row after its stall drains)
+                return
             name = self.rows.name(row)
             blob = self.app.checkpoint(name)
             reply = {

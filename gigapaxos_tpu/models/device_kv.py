@@ -82,23 +82,46 @@ def init_kv(n_replicas: int, n_groups: int, slots: int = 16,
     )
 
 
-def register_requests(kv: DeviceKVState, rids, ops, keys, vals) -> DeviceKVState:
+def _table_idx(rids, table: int, mix: bool):
+    """Descriptor-table index for a batch of rids.
+
+    ``mix=False`` (Mode A): plain low-bits mask — manager rids are one
+    sequential stream, so any live window of <= table consecutive rids maps
+    injectively (the eviction-safety invariant in paxos/manager.py).
+    ``mix=True`` (Mode B): rids are origin-tagged ``(origin << 24) | seq``
+    and every origin's seq streams advance together, so the plain mask
+    would collide ALL origins at equal seqs; a multiplicative (Fibonacci)
+    hash spreads them — a rare collision evicts a descriptor, which is a
+    miss, which is the (correct) scalar fallback."""
+    if not mix:
+        return jnp.bitwise_and(rids, table - 1)
+    h = (rids * jnp.int32(-1640531527)).astype(jnp.uint32)  # 0x9E3779B9
+    return jnp.bitwise_and(h >> jnp.uint32(8), table - 1).astype(I32)
+
+
+def register_requests(kv: DeviceKVState, rids, ops, keys, vals,
+                      mix: bool = False) -> DeviceKVState:
     """Upload request descriptors (host batch -> one scatter).  Clients call
     this before proposing the rids; collisions evict (the evicted request
-    will execute as a miss and fall back to the host slow path)."""
+    will execute as a miss and fall back to the host slow path).
+
+    rid 0 marks an EMPTY upload slot (fixed-size batches pad with zeros) —
+    those scatter out of bounds and drop, instead of clobbering whatever
+    live descriptor hashes to index 0 on every padded upload."""
     rids = jnp.asarray(rids, I32)
-    idx = jnp.bitwise_and(rids, kv.table - 1)
+    idx = jnp.where(rids == 0, kv.table, _table_idx(rids, kv.table, mix))
     return kv._replace(
-        t_rid=kv.t_rid.at[idx].set(rids),
-        t_op=kv.t_op.at[idx].set(jnp.asarray(ops, I32)),
-        t_key=kv.t_key.at[idx].set(jnp.asarray(keys, I32)),
-        t_val=kv.t_val.at[idx].set(jnp.asarray(vals, I32)),
+        t_rid=kv.t_rid.at[idx].set(rids, mode="drop"),
+        t_op=kv.t_op.at[idx].set(jnp.asarray(ops, I32), mode="drop"),
+        t_key=kv.t_key.at[idx].set(jnp.asarray(keys, I32), mode="drop"),
+        t_val=kv.t_val.at[idx].set(jnp.asarray(vals, I32), mode="drop"),
     )
 
 
 def kv_apply(kv: DeviceKVState, exec_req: jnp.ndarray,
-             exec_count: jnp.ndarray) -> Tuple[DeviceKVState, jnp.ndarray,
-                                               jnp.ndarray]:
+             exec_count: jnp.ndarray,
+             mix: bool = False) -> Tuple[DeviceKVState, jnp.ndarray,
+                                         jnp.ndarray]:
     """Vectorized execution of one tick's decision stream.
 
     exec_req: i32 [R, W, G] executed rids in window order (0 = none);
@@ -121,7 +144,7 @@ def kv_apply(kv: DeviceKVState, exec_req: jnp.ndarray,
     ji = jnp.arange(W, dtype=I32)
     valid = (exec_req != NO_REQUEST) & (ji[None, :, None] < exec_count[:, None, :])
 
-    tix = jnp.bitwise_and(exec_req, kv.table - 1)  # [R, W, G]
+    tix = _table_idx(exec_req, kv.table, mix)  # [R, W, G]
     hit = valid & (kv.t_rid[tix] == exec_req)
     op = jnp.where(hit, kv.t_op[tix], OP_NONE)
     k = kv.t_key[tix]

@@ -42,6 +42,10 @@ class BulkStore:
         self.valid = np.zeros(capacity, bool)
         self.rid = np.zeros(capacity, np.int64)  # occupant (stale-slot guard)
         self.payload = np.empty(capacity, object)
+        #: payload byte length, computed ONCE at admission — the execution
+        #: side runs per replica (R passes) and a per-object len() there
+        #: costs more than the whole vectorized lifecycle
+        self.pay_len = np.zeros(capacity, np.int32)
         self.response = np.empty(capacity, object)
         #: lowest rid that may still be live (slots below are reclaimable)
         self.lo = 0
@@ -93,8 +97,12 @@ class BulkStore:
         self.rid[idx] = rids
         if isinstance(payloads, (bytes, bytearray)):
             self.payload[idx] = bytes(payloads)
+            self.pay_len[idx] = len(payloads)
         else:
             self.payload[idx] = payloads
+            self.pay_len[idx] = np.fromiter(
+                (len(p) for p in payloads), np.int32, count=n
+            )
         self.response[idx] = None
         self.n_live += n
         return rids
@@ -124,10 +132,15 @@ class BulkStore:
         self.rid[ni] = rids[new]
         if isinstance(payloads, (bytes, bytearray)):
             self.payload[ni] = bytes(payloads)
+            self.pay_len[ni] = len(payloads)
         else:
             pa = np.empty(len(rids), object)
             pa[:] = list(payloads)
             self.payload[ni] = pa[new]
+            self.pay_len[ni] = np.fromiter(
+                (0 if p is None else len(p) for p in pa[new]), np.int32,
+                count=len(ni),
+            )
         self.response[ni] = None
         self.n_live += len(ni)
         if len(rids):
@@ -216,6 +229,10 @@ class BulkStore:
             return a
 
         self.payload[idx] = as_obj(snap["payload"])
+        self.pay_len[idx] = np.fromiter(
+            (0 if p is None else len(p) for p in snap["payload"]), np.int32,
+            count=len(rids),
+        )
         self.response[idx] = as_obj(snap.get("response", [None] * len(rids)))
         self.valid[idx] = True
         self.lo = int(snap["lo"])

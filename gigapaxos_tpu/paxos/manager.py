@@ -319,6 +319,7 @@ class PaxosManager:
         # against a future occupant
         self.drain_pipeline()
         self.state = st.free_groups(self.state, np.array([row], np.int32))
+        self._kv_clear_rows([row])
         self._clear_member_rows([row])
         self.rows.free(name)
         self._fail_queued(row)
@@ -448,6 +449,16 @@ class PaxosManager:
                 self.wal.log_pause(paused)
         return paused
 
+    def _kv_clear_rows(self, rows) -> None:
+        """Scrub device-app KV rows on free: a recycled row must not leak
+        the previous occupant's keys to the next group."""
+        if self.kv is not None and len(rows):
+            r = np.asarray(rows, np.int32)
+            self.kv = self.kv._replace(
+                key=self.kv.key.at[:, r].set(0),
+                val=self.kv.val.at[:, r].set(0),
+            )
+
     def _do_pause(self, names: List[str]) -> None:
         """Spill exactly ``names`` (selection already done — also the WAL
         replay entry point, which must mirror the original run's choice so
@@ -457,9 +468,15 @@ class PaxosManager:
             row = self.rows.row(name)
             hri = st.extract_hri(self.state, row)
             hri["stopped"] = row in self._stopped_rows
+            if self.kv is not None:
+                # device-app state is keyed by ROW — it must ride the
+                # spilled record or pause would silently drop it
+                hri["dkv_key"] = np.asarray(self.kv.key[:, row])
+                hri["dkv_val"] = np.asarray(self.kv.val[:, row])
             self._paused[name] = hri
             rows_to_free.append(row)
         self.state = st.free_groups(self.state, np.array(rows_to_free, np.int32))
+        self._kv_clear_rows(rows_to_free)
         self._clear_member_rows(rows_to_free)
         for name in names:
             row = self.rows.free(name)
@@ -484,6 +501,11 @@ class PaxosManager:
         )
         self._set_member_row(row, mask[0], name)
         self.state = st.hot_restore(self.state, row, hri)
+        if self.kv is not None and "dkv_key" in hri:
+            self.kv = self.kv._replace(
+                key=self.kv.key.at[:, row].set(jnp.asarray(hri["dkv_key"])),
+                val=self.kv.val.at[:, row].set(jnp.asarray(hri["dkv_val"])),
+            )
         if hri.get("stopped"):
             self._stopped_rows.add(row)
             self._stopped_np[row] = True
@@ -1336,7 +1358,8 @@ class PaxosManager:
                 else:
                     erb = getattr(self.apps[r], "execute_rows_batch", None)
                     if erb is not None:
-                        resp = erb(row_r, store.payload[idx_r], rid_r)
+                        resp = erb(row_r, store.payload[idx_r], rid_r,
+                                   lens=store.pay_len[idx_r])
                     else:
                         resp = self.apps[r].execute_batch(
                             self._row_name_np[row_r], store.payload[idx_r],

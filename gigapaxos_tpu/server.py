@@ -135,6 +135,11 @@ class ModeBServer:
                     os.path.join(log_dir, f"{node_id}-ar") if log_dir else None,
                     spill_ns=f"{node_id}-ar",
                 )
+                if cfg.paxos.device_app:
+                    # device mode: the node built its own DeviceKVApp face
+                    # over the device arrays; the control plane (epoch
+                    # final-state, demand, tests) must see THAT app
+                    self.app = node.app
             else:
                 raise ValueError(f"unknown coordinator {coordinator!r}")
             self.coordinator = ModeBReplicaCoordinator(node)
@@ -177,7 +182,7 @@ class ModeBServer:
             rc_node, recovered = self._make_node(
                 rc_ids, db,
                 os.path.join(log_dir, f"{node_id}-rc") if log_dir else None,
-                spill_ns=f"{node_id}-rc",
+                spill_ns=f"{node_id}-rc", rc_plane=True,
             )
             self.rdb = ModeBRepliconfigurableDB(rc_node, rc_ids, k=rc_group_size)
             fd = None
@@ -256,22 +261,33 @@ class ModeBServer:
         node.on_work = driver.kick
         return driver.start()
 
-    def _make_node(self, member_ids, app, wal_dir, spill_ns=None):
+    def _make_node(self, member_ids, app, wal_dir, spill_ns=None,
+                   rc_plane=False):
         """Build (or WAL-recover) one plane's ModeBNode, messenger-less —
         the caller attaches the messenger after the control-plane endpoint
         claims its handlers (3-pass recovery before live traffic,
         PaxosManager.initiateRecovery, PaxosManager.java:1852)."""
+        cfg = self.cfg
+        if rc_plane and cfg.paxos.device_app:
+            # the RC DB is a host state machine: a device-app data plane
+            # must not leak its mode into the control plane (node.py does
+            # the same for Mode A)
+            import copy as _copy
+            import dataclasses as _dc
+
+            cfg = _copy.copy(cfg)
+            cfg.paxos = _dc.replace(cfg.paxos, device_app=False)
         if wal_dir and os.path.isdir(wal_dir) and os.listdir(wal_dir):
             node = recover_modeb(
-                self.cfg, member_ids, self.node_id, app, wal_dir,
-                native=self.cfg.native_journal, spill_ns=spill_ns,
+                cfg, member_ids, self.node_id, app, wal_dir,
+                native=cfg.native_journal, spill_ns=spill_ns,
             )
             return node, True
         wal = None
         if wal_dir:
-            wal = ModeBLogger(wal_dir, native=self.cfg.native_journal)
+            wal = ModeBLogger(wal_dir, native=cfg.native_journal)
         node = ModeBNode(
-            self.cfg, member_ids, self.node_id, app, messenger=None,
+            cfg, member_ids, self.node_id, app, messenger=None,
             wal=wal, spill_ns=spill_ns,
         )
         return node, False

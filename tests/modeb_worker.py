@@ -60,6 +60,14 @@ def main() -> None:
         wal = ModeBLogger(wal_dir, native=False)
         node = ModeBNode(cfg, ids, node_id, app, m, wal=wal)
 
+    # keep-alive failure detection, like the real server: survivors must
+    # mark a SIGKILL'd peer dead on their own (no manual liveness anywhere)
+    from gigapaxos_tpu.net.failure_detection import FailureDetection
+
+    fd = FailureDetection(m, monitored=ids, ping_interval_s=0.2,
+                          timeout_s=2.0)
+    node.attach_failure_detector(fd)
+
     # event-driven pumping like the real server (the old fixed 4 ms sleep
     # capped the only multi-process deployment at ~250 ticks/s)
     from gigapaxos_tpu.paxos.driver import TickDriver
@@ -91,6 +99,7 @@ def main() -> None:
             emit("db " + json.dumps(app.db, sort_keys=True))
         elif cmd == "exit":
             break
+    fd.close()
     driver.stop()
     node.close()
 

@@ -27,6 +27,8 @@ def main() -> None:
     spec = json.loads(sys.argv[2])
     cfg = GigapaxosTpuConfig()
     cfg.paxos.max_groups = int(spec.get("max_groups", 32))
+    if spec.get("device_app"):
+        cfg.paxos.device_app = True
     # gentle FD cadence: 7 processes share this box's core(s), and 50ms
     # pings across 7x3 pairs are real CPU; detection latency ~2s is plenty
     cfg.fd.ping_interval_s = float(spec.get("fd_ping", 0.2))

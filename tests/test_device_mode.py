@@ -303,3 +303,32 @@ def test_compact_layout_single_source_of_truth():
     # PUT echoes the stored value through the layout's response column
     assert (e_resp[:co.n_exec][execd] == 1234).all()
     assert (e_miss[:co.n_exec][execd] == 0).all()
+
+
+def test_device_row_lifecycle_no_leak_and_pause_preserves():
+    """Mode A twin of the Mode B lifecycle test: removed rows scrub their
+    device KV data; paused groups carry it in the spilled record."""
+    m, _ = mk(G=4)
+    assert m.create_paxos_instance("old", [0, 1, 2])
+    got = {}
+    m.propose_bulk_kv(np.array([m.rows.row("old")]), [OP_PUT], [5], [77],
+                      callbacks=[lambda rid, r: got.setdefault("p", r)])
+    drain(m)
+    assert got["p"] == struct.pack("<i", 77)
+    assert m.remove_paxos_instance("old")
+    assert m.create_paxos_instance("fresh", [0, 1, 2])
+    m.propose_bulk_kv(np.array([m.rows.row("fresh")]), [OP_GET], [5], [0],
+                      callbacks=[lambda rid, r: got.setdefault("g", r)])
+    drain(m)
+    assert got["g"] == struct.pack("<i", 0)  # no leak from "old"
+
+    m.propose_bulk_kv(np.array([m.rows.row("fresh")]), [OP_PUT], [2], [42],
+                      callbacks=[lambda rid, r: got.setdefault("p2", r)])
+    drain(m)
+    paused = m._pause_eligible(limit=4, ignore_idle=True)
+    assert "fresh" in paused
+    # transparent unpause on propose; state preserved through the spill
+    m.propose_bulk_kv(np.array([m._resident_row("fresh")]), [OP_GET], [2],
+                      [0], callbacks=[lambda rid, r: got.setdefault("g2", r)])
+    drain(m)
+    assert got["g2"] == struct.pack("<i", 42)

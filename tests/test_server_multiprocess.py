@@ -193,3 +193,91 @@ def test_full_deployment_sigkill_coordinator_and_rc(tmp_path):
     finally:
         for p in procs.values():
             p.close()
+
+
+@pytest.mark.slow
+def test_device_app_deployment_sigkill_recovery(tmp_path):
+    """Device app in the per-process deployment (VERDICT r4 item 5): 3
+    active + 1 RC OS processes with cfg.paxos.device_app — descriptors
+    commit through the fused device tick, a SIGKILL'd coordinator fails
+    over by FD alone, and the killed process restarts from its own WAL
+    with its device arrays reproduced."""
+    import struct
+
+    from gigapaxos_tpu.models.device_kv import OP_GET, OP_PUT, pack_desc
+
+    actives = ["A0", "A1", "A2"]
+    spec = {
+        "actives": {a: ["127.0.0.1", free_port()] for a in actives},
+        "rcs": {"R0": ["127.0.0.1", free_port()]},
+        "fd_timeout": 2.0,
+        "device_app": True,
+        "log_dir": str(tmp_path),
+    }
+    procs = {nid: ServerProc(nid, spec) for nid in actives + ["R0"]}
+    client = None
+    try:
+        for p in procs.values():
+            p.wait_ready()
+        nodes = GigapaxosTpuConfig().nodes
+        for a, (h, pt) in spec["actives"].items():
+            nodes.actives[a] = (h, pt)
+        for r, (h, pt) in spec["rcs"].items():
+            nodes.reconfigurators[r] = (h, pt)
+        client = ReconfigurableAppClient(nodes)
+
+        resp = client.create("svc", timeout=180)
+        assert resp["ok"] or resp.get("error") == "exists", resp
+        # descriptor workload end-to-end: PUT echoes value, GET reads it
+        for i in range(4):
+            r = client.request("svc", pack_desc(OP_PUT, i + 1, 50 + i),
+                               timeout=60)
+            assert r == struct.pack("<i", 50 + i), (i, r)
+        assert client.request("svc", pack_desc(OP_GET, 2, 0),
+                              timeout=60) == struct.pack("<i", 51)
+
+        # SIGKILL the coordinator process; FD-only failover
+        members = sorted(client.request_actives("svc"))
+        coord = min(members, key=actives.index)
+        procs[coord].sigkill()
+        deadline = time.monotonic() + 90
+        committed = False
+        while time.monotonic() < deadline and not committed:
+            try:
+                committed = client.request(
+                    "svc", pack_desc(OP_PUT, 9, 999), timeout=10
+                ) == struct.pack("<i", 999)
+            except (ClientError, TimeoutError):
+                time.sleep(0.5)
+        assert committed, "no device-mode commit after coordinator SIGKILL"
+
+        # restart from its own WAL: device arrays reproduced + catches up
+        procs[coord] = ServerProc(coord, spec)
+        procs[coord].wait_ready()
+        deadline = time.monotonic() + 120
+        got = None
+        while time.monotonic() < deadline:
+            try:
+                box = request_via(client, "svc", pack_desc(OP_GET, 9, 0),
+                                  coord, timeout=10)
+                if box.get("ok"):
+                    from gigapaxos_tpu.reconfiguration import packets as pkt
+
+                    got = pkt.b64d(box.get("response"))
+                    if got == struct.pack("<i", 999):
+                        break
+            except TimeoutError:
+                pass
+            time.sleep(0.5)
+        assert got == struct.pack("<i", 999), got
+        # pre-crash state also survived in the recovered device arrays
+        box = request_via(client, "svc", pack_desc(OP_GET, 2, 0), coord,
+                          timeout=30)
+        from gigapaxos_tpu.reconfiguration import packets as pkt
+
+        assert pkt.b64d(box.get("response")) == struct.pack("<i", 51)
+    finally:
+        if client is not None:
+            client.close()
+        for p in procs.values():
+            p.close()

@@ -1,0 +1,76 @@
+"""Profile the socket-path capacity edge at a fixed offered load.
+
+Answers VERDICT r4 item 6: what caps the batched loopback knee on this box
+— client-side per-request Python, server-side admission, completion
+fan-out, or the 1-core floor itself.  Runs the whole in-process cluster
+(client + 3 ARs + RC on loopback) under cProfile at --load for --duration
+seconds and prints the top cumulative functions plus the achieved rate.
+
+Usage: python benchmarks/capacity_profile.py [--load 15000] [--duration 8]
+       [--batch/--no-batch] [--platform cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import cProfile
+import io
+import json
+import os
+import pstats
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--load", type=float, default=15000.0)
+    ap.add_argument("--duration", type=float, default=8.0)
+    ap.add_argument("--groups", type=int, default=10)
+    ap.add_argument("--no-batch", action="store_true")
+    ap.add_argument("--platform", default="cpu")
+    ap.add_argument("--top", type=int, default=30)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from gigapaxos_tpu.testing.capacity import (CapacityProbe,
+                                                make_loopback_cluster)
+
+    cluster, client = make_loopback_cluster(n_groups=args.groups)
+    try:
+        probe = CapacityProbe(client, [f"g{i}" for i in range(args.groups)],
+                              batch=not args.no_batch)
+        probe.run_once(min(args.load, 2000.0), 2.0)  # warm every path
+        pr = cProfile.Profile()
+        pr.enable()
+        r = probe.run_once(args.load, args.duration)
+        pr.disable()
+        print(json.dumps({
+            "metric": "capacity_profile_rate_req_per_s",
+            "value": round(r.response_rate, 1),
+            "offered": args.load,
+            "sent": r.sent,
+            "responded_in_window": r.responded_in_window,
+            "p50_latency_ms": round(r.p50_latency_s() * 1e3, 2),
+            "batch": not args.no_batch,
+        }))
+        buf = io.StringIO()
+        st = pstats.Stats(pr, stream=buf)
+        st.sort_stats("cumulative")
+        st.print_stats(args.top)
+        # keep only the table (drop the preamble garbage)
+        for line in buf.getvalue().splitlines():
+            if line.strip():
+                print(line)
+    finally:
+        client.close()
+        cluster.close()
+
+
+if __name__ == "__main__":
+    main()

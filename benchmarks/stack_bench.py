@@ -42,6 +42,13 @@ def main() -> None:
     ap.add_argument("--sync-every", type=int, default=8)
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu)")
+    ap.add_argument("--baseline", choices=["unreplicated", "lazy"],
+                    default=None,
+                    help="measurement baseline (PaxosManager.java:1751-1799)"
+                         ": 'unreplicated' executes at the entry replica "
+                         "with no coordination at all; 'lazy' responds at "
+                         "the entry and propagates through consensus in "
+                         "the background")
     ap.add_argument("--profile", action="store_true",
                     help="report per-stage host timings")
     args = ap.parse_args()
@@ -70,6 +77,10 @@ def main() -> None:
     cfg.paxos.deactivation_ticks = 0  # no pause scans mid-measurement
     if args.device:
         cfg.paxos.device_app = True
+    if args.baseline == "unreplicated":
+        cfg.paxos.emulate_unreplicated = True
+    elif args.baseline == "lazy":
+        cfg.paxos.lazy_propagation = True
 
     apps = ([None] * R if args.device
             else [DenseCounterApp(G) for _ in range(R)])
@@ -151,6 +162,7 @@ def main() -> None:
     result = {
         "metric": f"stack_decisions_per_sec_{G}_groups_{R}_replicas"
                   + ("_device_kv" if args.device else "")
+                  + (f"_{args.baseline}" if args.baseline else "")
                   + ("_wal" if args.wal else "")
                   + (f"_{backend}" if backend not in ("tpu", "axon") else ""),
         "value": round(decisions / dt, 1),

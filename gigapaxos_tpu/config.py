@@ -104,6 +104,17 @@ class PaxosTuning:
     # stream (undigest fetches retried underneath) before the node gives
     # up and repairs by checkpoint transfer instead.
     undigest_timeout_ticks: int = 256
+    # MEASUREMENT-ONLY baseline modes for attributing replication cost
+    # (PaxosManager.java:1751-1799 emulateUnreplicated/emulateLazyPropagation,
+    # EXECUTE_UPON_ACCEPT PaxosInstanceStateMachine.java:1077).  Never set
+    # on a real deployment: both break agreement/durability by design.
+    # unreplicated: propose_bulk executes at the entry replica immediately
+    # and responds — no coordination, no journal, nothing replicated.
+    emulate_unreplicated: bool = False
+    # lazy_propagation: the entry replica executes + responds immediately;
+    # the request still rides the normal consensus stream so OTHER replicas
+    # converge eventually (response latency excludes the quorum round).
+    lazy_propagation: bool = False
     # Tick coalescing: minimum spacing between driver ticks while busy.
     # Each tick has a fixed host cost (admission, placement, compaction
     # unpack); spacing ticks lets requests accumulate so that cost

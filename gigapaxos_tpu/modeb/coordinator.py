@@ -100,24 +100,42 @@ class ModeBReplicaCoordinator(AbstractReplicaCoordinator):
         pname = self._pax_name(name, epoch)
         # pre-check so a stopped/unknown group returns None (AR replies
         # not_active) instead of also firing the callback with a failure —
-        # the entry node is this process, so no entry-slot indirection
-        if self.node.rows.row(pname) is None or self.node.is_stopped(pname):
+        # the entry node is this process, so no entry-slot indirection.
+        # A tainted row (awaiting checkpoint repair) must not serve either:
+        # its app copy is not authoritative yet — the client rotates to a
+        # caught-up member meanwhile.
+        if (self.node.rows.row(pname) is None or self.node.is_stopped(pname)
+                or self.node.is_tainted(pname)):
             return None
         return self.node.propose(pname, payload, callback)
 
     def create_replica_group(
-        self, name: str, epoch: int, initial_state: bytes, nodes: List[str]
+        self, name: str, epoch: int, initial_state: bytes, nodes: List[str],
+        tainted: bool = False,
     ) -> bool:
         slots = [self._slot[n] for n in nodes if n in self._slot]
         if not slots:
             return False
         pname = self._pax_name(name, epoch)
-        ok = self.node.create_group(pname, slots, epoch)
-        if not ok:
-            return False
-        # seed app state on THIS member only — every member process runs its
-        # own StartEpoch (the reference delivers StartEpoch per active too)
-        self.node.app.restore(pname, initial_state)
+        # birth + seed + taint atomically vs the tick AND messenger
+        # threads: a decision executing between birth and seed would read
+        # pre-seed state, and a peer's checkpoint request between birth
+        # and taint would be DONATED the empty pre-state — which the peer
+        # adopts, clears its own taint with, and re-donates (an
+        # empty-state cascade that loses the epoch's data for good)
+        with self.node.lock:
+            ok = self.node.create_group(pname, slots, epoch)
+            if not ok:
+                return False
+            # seed app state on THIS member only — every member process
+            # runs its own StartEpoch (the reference delivers StartEpoch
+            # per active too)
+            self.node.app.restore(pname, initial_state)
+            if tainted:
+                # born without the carried state (previous epoch GC'd
+                # under us): never serve or donate until checkpoint
+                # transfer from a caught-up member of THIS epoch repairs
+                self.node.mark_tainted(pname)
         live = self._epoch.get(name)
         if live is None or epoch > live:
             self._epoch[name] = epoch
@@ -167,6 +185,22 @@ class ModeBReplicaCoordinator(AbstractReplicaCoordinator):
         if not self.node.is_stopped(pname) or self.node.is_tainted(pname):
             return None
         return self.node.app.checkpoint(pname)
+
+    def final_state_gone(self, name: str, epoch: int) -> bool:
+        """True when this node can say the epoch's final state is GONE for
+        good (dropped by GC) rather than merely not-stopped-yet.  A gone
+        answer implies the reconfiguration COMPLETE committed (drop runs
+        only after it), hence a majority of the NEW epoch holds the real
+        state — the asker may safely birth tainted and repair from them."""
+        pname = self._pax_name(name, epoch)
+        with self.node.lock:
+            if self.node.rows.row(pname) is not None:
+                return False  # still hosted (stopped or not): transient
+            if pname in self.node._paused:
+                return False
+            live = self._epoch.get(name, -1)
+            # hosted later epoch, or dropped our last epoch entirely
+            return live > epoch or live == -1
 
     def drop_final_state(self, name: str, epoch: int) -> bool:
         pname = self._pax_name(name, epoch)

@@ -36,6 +36,7 @@ OP_FRAME = 6
 OP_CKPT = 7
 OP_EXPAND = 8
 OP_PAYLOAD = 9  # out-of-band payload arrival (undigest reply)
+OP_TAINT = 10   # row marked not-authoritative (tainted epoch birth)
 
 
 def replay_node_journals(node, log_dir, start_seq, stage, new_buffers,
@@ -83,6 +84,13 @@ def replay_node_journals(node, log_dir, start_seq, stage, new_buffers,
                 _, rid, pl, stop = rec
                 if rid not in node.outstanding and rid not in node.payloads:
                     node._store_payload(rid, pl, stop)
+            elif op == OP_TAINT:
+                # a tainted birth must survive the crash: an untainted
+                # recovered row with empty state would serve bad reads AND
+                # donate the empty state to tainted peers (state loss)
+                row = node.rows.row(rec[1])
+                if row is not None:
+                    node._tainted_rows.add(row)
             elif op == OP_CKPT:
                 _, gid, packet = rec
                 row = node._gid_row.get(gid)
@@ -146,6 +154,11 @@ class ModeBLogger(PaxosLogger):
         """Journal an applied replica frame (before mirror mutation; rides
         the next tick's group commit for fsync)."""
         self.journal.append(records.dumps((OP_FRAME, payload)))
+
+    def log_taint(self, name: str) -> None:
+        """Journal a taint mark (out-of-tick mutation, like log_ckpt)."""
+        self.journal.append(records.dumps((OP_TAINT, name)))
+        self.journal.sync()
 
     def log_payload(self, rid: int, payload: bytes, stop: bool) -> None:
         """Journal an out-of-band payload fill (undigest reply): it changes

@@ -159,6 +159,9 @@ class ModeBNode(ModeBCommon):
         #: (orphan exec) — repaired by checkpoint transfer, until which the
         #: local app copy must not be trusted as a donor
         self._tainted_rows: set = set()
+        #: per-row checkpoint-request attempts (donor rotation); cleared on
+        #: successful adoption
+        self._ckpt_tries: Dict[int, int] = {}
         self._force_full = True  # first frame announces full own row
         self._placed: list = []
         #: pipelined mode: (outbox, placed) of the last dispatched tick
@@ -359,6 +362,8 @@ class ModeBNode(ModeBCommon):
             self._queues.pop(row, None)
             self._stalled.pop(row, None)
             self._stall_tick.pop(row, None)
+            self._tainted_rows.discard(row)
+            self._ckpt_tries.pop(row, None)
             self._stopped_rows.discard(row)
             self._occupied[row] = False
             self._dirty[row] = False
@@ -547,6 +552,19 @@ class ModeBNode(ModeBCommon):
         with self.lock:
             row = self.rows.row(name)
             return row is not None and row in self._tainted_rows
+
+    def mark_tainted(self, name: str) -> None:
+        """Explicitly flag a row as not-authoritative (e.g. an epoch group
+        born without its carried state because the previous epoch's final
+        state was GC'd) — `_check_laggard` repairs it by checkpoint
+        transfer from a caught-up peer.  Journaled: a crash must not
+        resurrect the row untainted with its empty birth state."""
+        with self.lock:
+            row = self.rows.row(name)
+            if row is not None:
+                self._tainted_rows.add(row)
+                if self.wal is not None and hasattr(self.wal, "log_taint"):
+                    self.wal.log_taint(name)
 
     # ---------------------------------------------------------------- propose
     def propose(self, name: str, payload: bytes,
@@ -1294,11 +1312,21 @@ class ModeBNode(ModeBCommon):
                 self._tainted_rows.discard(row)
                 continue
             ex = exec_all[:, int(row)]
-            donors = [i for i in range(self.R)
-                      if i != self.r and self.alive[i]]
+            # only the group's MEMBERS can donate (a non-member's
+            # _gid_row lookup silently drops the request)
+            meta = self._row_meta.get(int(row))
+            members = meta[1] if meta else range(self.R)
+            donors = [i for i in members
+                      if i != self.r and 0 <= i < self.R and self.alive[i]]
             if not donors:
                 continue
-            donor = max(donors, key=lambda i: ex[i])
+            # best watermark first, but ROTATE across retries: with tied
+            # (e.g. all-zero mirror) watermarks a fixed pick can hammer a
+            # peer that refuses to donate (itself tainted/stalled) forever
+            # while a willing donor sits unasked
+            donors.sort(key=lambda i: ex[i], reverse=True)
+            tries = self._ckpt_tries[row] = self._ckpt_tries.get(row, 0) + 1
+            donor = donors[(tries - 1) % len(donors)]
             self.m.send(self.members[donor], {
                 "type": MB_CKPT_REQ, "gid": str(wire.gid_of(name)),
                 "have": int(ex[self.r]),
@@ -1367,6 +1395,7 @@ class ModeBNode(ModeBCommon):
             self._stopped_rows.add(row)
         self._seen.pop(row, None)
         self._tainted_rows.discard(row)
+        self._ckpt_tries.pop(row, None)
         self._dirty[row] = True
         self.stats["ckpt_transfers"] += 1
 

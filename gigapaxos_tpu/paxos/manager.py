@@ -155,6 +155,11 @@ class PaxosManager:
         if self._device_app:
             if not self._use_compact:
                 raise ValueError("device_app requires compact_outbox")
+            if cfg.paxos.emulate_unreplicated or cfg.paxos.lazy_propagation:
+                raise ValueError(
+                    "baseline modes are host-app measurement tools; the "
+                    "device app executes on-device only"
+                )
             from ..models.device_kv import DeviceKVApp, init_kv
 
             table = cfg.paxos.kv_table or (
@@ -726,16 +731,88 @@ class PaxosManager:
         if entries is not None:
             e = int(entries)
             ent = np.where(self._member_np[e, rows], e, ent).astype(np.int32)
+        if self.cfg.paxos.emulate_unreplicated:
+            # measurement baseline (emulateUnreplicated,
+            # PaxosManager.java:1751-1799): execute at the entry replica NOW,
+            # respond, touch nothing else — no store, no tick, no journal
+            self._baseline_exec(rows, ent, payloads, rid0 + np.arange(
+                n_adm, dtype=np.int64), callbacks, eager_fire=True)
+            self.stats["decisions"] += n_adm
+            out[np.nonzero(ok)[0][:n_adm]] = rid0 + np.arange(n_adm)
+            return out
         rids = store.admit(rid0, rows.astype(np.int32), ent, stops,
                            payloads)
         if callbacks is not None:
             for rid, cb in zip(rids, callbacks):
                 if cb is not None:
                     self._bulk_cbs[int(rid)] = cb
+        if self.cfg.paxos.lazy_propagation:
+            # measurement baseline (emulateLazyPropagation /
+            # EXECUTE_UPON_ACCEPT): the entry replica executes + responds
+            # immediately; the admitted request still rides the normal
+            # consensus stream, so the other replicas converge through
+            # ordinary decisions (their mark_executed skips the entry's
+            # pre-set bit — no double execution)
+            idx = store.idx_of(rids)
+            self._baseline_exec(rows, ent, store.payload[idx], rids,
+                                callbacks=None, eager_fire=False,
+                                store_idx=idx)
         self._bulk_chunks.append(rids)
         self._last_active[rows] = self.tick_num
         out[np.nonzero(ok)[0][:n_adm]] = rids
         return out
+
+    def _baseline_exec(self, rows, ent, payloads, rids, callbacks,
+                       eager_fire: bool, store_idx=None) -> None:
+        """Entry-replica immediate execution for the two measurement
+        baselines.  With ``store_idx`` (lazy mode) the store's entry exec
+        bit + responded flag are pre-set so commit-time execution skips
+        the entry replica and never re-responds."""
+        if isinstance(payloads, (bytes, bytearray)):
+            pa = np.empty(len(rows), object)
+            pa[:] = bytes(payloads)
+            payloads = pa
+        payloads = np.asarray(payloads, object)
+        rows = np.asarray(rows, np.int64)
+        eager: list = []
+        for r in range(self.R):
+            sel = ent == r
+            if not sel.any():
+                continue
+            erb = getattr(self.apps[r], "execute_rows_batch", None)
+            if erb is not None:
+                resp = erb(rows[sel], payloads[sel], rids[sel])
+            else:
+                resp = self.apps[r].execute_batch(
+                    self._row_name_np[rows[sel]], payloads[sel], rids[sel]
+                )
+            self.stats["executions"] += int(sel.sum())
+            if store_idx is not None:
+                si = store_idx[sel]
+                self.bulk.exec_mask[si] |= np.int64(1) << r
+                self.bulk.responded[si] = True
+                if resp is not None:
+                    ra = np.empty(len(si), object)
+                    ra[:] = resp
+                    self.bulk.response[si] = ra
+                if self._bulk_cbs:
+                    self._bulk_fire(rids[sel],
+                                    resp if resp is not None
+                                    else [b""] * int(sel.sum()))
+            elif eager_fire and callbacks is not None:
+                for pos, j in enumerate(np.nonzero(sel)[0]):
+                    cb = callbacks[j]
+                    if cb is not None:
+                        r_j = resp[pos] if resp is not None else b""
+                        # fired inline below — NEVER through the shared
+                        # durability-gated queue, whose other occupants
+                        # must keep waiting for their WAL sync
+                        eager.append((cb, int(rids[j]), r_j or b""))
+        if eager_fire:
+            # the unreplicated baseline responds inline (no durability by
+            # definition)
+            for cb, rid, resp in eager:
+                cb(rid, resp)
 
     def _bulk_fire(self, rids, responses=None) -> None:
         """Queue completion callbacks for bulk rids that just reached their

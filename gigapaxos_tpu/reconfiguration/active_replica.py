@@ -58,10 +58,17 @@ class WaitEpochFinalState(ProtocolTask):
     #: every duplicate request triggers a full re-send of the state
     announce_patience_s = 30.0
 
+    #: never conclude "the previous epoch was GC'd" before this much real
+    #: time: a denial burst can just be the previous actives' stops still
+    #: executing under load, and a premature empty birth costs a repair
+    give_up_floor_s = 12.0
+
     def __init__(self, ar: "ActiveReplica", packet: dict):
         self.ar = ar
         self.p = packet
         self._i = 0
+        self._gone = False  # some previous active reported the state GC'd
+        self._born = time.monotonic()
         self._announced_at: Optional[float] = None
 
     @property
@@ -74,6 +81,15 @@ class WaitEpochFinalState(ProtocolTask):
                 return []  # chunks in flight; don't provoke duplicate sends
             self._announced_at = None  # transfer presumably died: re-request
         name, prev = self.p["name"], self.p["prev_epoch"]
+        # our own copy may have materialized since the last round (this
+        # member's stop executed late): never poll remotely for state we
+        # hold locally
+        state = self.ar.coord.get_final_state(name, prev)
+        if state is not None:
+            self.ar.executor.handle_event(
+                self.key, {"found": True, "state_bytes": state}
+            )
+            return []
         targets = [a for a in self.p["prev_actives"] if a != self.ar.node_id]
         if not targets:
             return []
@@ -84,6 +100,34 @@ class WaitEpochFinalState(ProtocolTask):
 
     def handle(self, event: dict):
         if not event.get("found"):
+            # Liveness hole this guards (round-5 root cause of the
+            # migrate/recreate stalls): the complete commits at a MAJORITY
+            # of AckStarts, after which WaitAckDropEpoch GCs the previous
+            # epoch — a slow member (typically the newcomer, the one that
+            # must fetch remotely) could then find NO donor forever and
+            # serve not_active for good.  A donor distinguishes "not
+            # stopped yet" (transient; keep polling — giving up here could
+            # taint EVERY new member and lose the state) from "dropped by
+            # GC" (gone=True).  Gone implies the complete committed, which
+            # implies a MAJORITY of the new epoch holds the real state —
+            # so it is provably safe to birth EMPTY + TAINTED and let the
+            # data plane's checkpoint transfer repair this member from a
+            # caught-up peer (the tainted row refuses to serve/donate
+            # until then).
+            self._gone = self._gone or bool(event.get("gone"))
+            if (self._gone
+                    and time.monotonic() - self._born
+                    >= self.give_up_floor_s):
+                # one more local check: our own stop may have completed
+                # while we were polling remotely
+                state = self.ar.coord.get_final_state(
+                    self.p["name"], self.p["prev_epoch"]
+                )
+                if state is not None:
+                    self.ar._create_started_epoch(self.p, state)
+                else:
+                    self.ar._create_started_epoch(self.p, b"", tainted=True)
+                return [], True
             return [], False
         if "state_bytes" in event:  # assembled bulk transfer
             state = event["state_bytes"]
@@ -94,6 +138,15 @@ class WaitEpochFinalState(ProtocolTask):
             state = pkt.b64d(event.get("state")) or b""
         self.ar._create_started_epoch(self.p, state)
         return [], True
+
+    def on_done(self) -> None:
+        # max_restarts exhausted without a donor (every previous active
+        # denied for the whole budget): last-resort tainted birth so the
+        # member regains liveness; checkpoint repair or a later epoch
+        # supersedes.  No-op when the fetch completed normally.
+        cur = self.ar.coord.current_epoch(self.p["name"])
+        if cur is None or cur < self.p["epoch"]:
+            self.ar._create_started_epoch(self.p, b"", tainted=True)
 
 
 class ActiveReplica:
@@ -576,11 +629,17 @@ class ActiveReplica:
         state = self.coord.get_final_state(name, p["prev_epoch"])
         if state is not None:
             self._create_started_epoch(p, state)
-        else:
+        elif [a for a in p["prev_actives"] if a != self.node_id]:
             self.executor.schedule(WaitEpochFinalState(self, p))
+        else:
+            # no previous active to ask and no local copy: born tainted,
+            # repaired by checkpoint transfer from the new epoch's peers
+            self._create_started_epoch(p, b"", tainted=True)
 
-    def _create_started_epoch(self, p: dict, state: bytes) -> None:
-        self.coord.create_replica_group(p["name"], p["epoch"], state, p["actives"])
+    def _create_started_epoch(self, p: dict, state: bytes,
+                              tainted: bool = False) -> None:
+        self.coord.create_replica_group(p["name"], p["epoch"], state,
+                                        p["actives"], tainted=tainted)
         self._ack_start(p)
 
     def _ack_start(self, p: dict) -> None:
@@ -606,7 +665,18 @@ class ActiveReplica:
     def _on_request_final_state(self, sender: str, p: dict) -> None:
         name, epoch = p["name"], p["epoch"]
         state = self.coord.get_final_state(name, epoch)
-        if state is not None and len(state) > self.inline_state_limit:
+        if state is None:
+            # distinguish "not stopped yet" (asker keeps polling) from
+            # "dropped by GC" (asker may give up and birth tainted — a
+            # gone answer implies the complete committed, so a majority of
+            # the new epoch holds the real state)
+            fsg = getattr(self.coord, "final_state_gone", None)
+            reply = pkt.epoch_final_state(name, epoch, None)
+            if fsg is not None and fsg(name, epoch):
+                reply["gone"] = True
+            self.m.send(p["requester"], reply)
+            return
+        if len(state) > self.inline_state_limit:
             self.m.send(p["requester"], {
                 "type": pkt.EPOCH_FINAL_STATE, "name": name, "epoch": epoch,
                 "found": True, "bulk": True,

@@ -46,8 +46,14 @@ class AbstractReplicaCoordinator(abc.ABC):
 
     @abc.abstractmethod
     def create_replica_group(
-        self, name: str, epoch: int, initial_state: bytes, nodes: List[str]
+        self, name: str, epoch: int, initial_state: bytes, nodes: List[str],
+        tainted: bool = False,
     ) -> bool:
+        """``tainted``: the epoch is born WITHOUT its authoritative state
+        (the previous epoch's final state was GC'd before this member could
+        fetch it) — the member must not serve or donate until the plane's
+        checkpoint-transfer repair pulls the state from a caught-up peer
+        of the NEW epoch."""
         ...
 
     @abc.abstractmethod
@@ -205,19 +211,27 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
         return list(out)
 
     def create_replica_group(
-        self, name: str, epoch: int, initial_state: bytes, nodes: List[str]
+        self, name: str, epoch: int, initial_state: bytes, nodes: List[str],
+        tainted: bool = False,
     ) -> bool:
+        # Mode A note: the shared in-process plane seeds every member from
+        # one create, so the tainted fallback (remote final state GC'd)
+        # cannot leave this plane stateless — accepted and ignored.
         slots = [self._slot[n] for n in nodes if n in self._slot]
         if not slots:
             return False
         pname = self._pax_name(name, epoch)
-        ok = self.manager.create_paxos_instance(pname, slots, epoch)
-        if not ok:
-            return False
-        # seed app state on every member replica (StartEpoch's final-state
-        # hand-off; b"" = fresh name)
-        for s in slots:
-            self.manager.apps[s].restore(pname, initial_state)
+        # birth + seed atomically vs the tick thread (reentrant lock): an
+        # execution between them would read/write pre-seed app state that
+        # the restore then silently overwrites
+        with self.manager.lock:
+            ok = self.manager.create_paxos_instance(pname, slots, epoch)
+            if not ok:
+                return False
+            # seed app state on every member replica (StartEpoch's
+            # final-state hand-off; b"" = fresh name)
+            for s in slots:
+                self.manager.apps[s].restore(pname, initial_state)
         live = self._epoch.get(name)
         if live is None or epoch > live:
             self._epoch[name] = epoch

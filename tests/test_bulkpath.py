@@ -317,3 +317,78 @@ def test_dense_counter_batch_matches_scalar_mixed_sizes():
     payloads2[:] = [struct.pack("<q", v) for v in (1, 2, 3)]
     a.execute_rows_batch(np.array([5, 5, 6]), payloads2, np.arange(3))
     assert a.acc[5] == 3 and a.acc[6] == 3
+
+
+def test_unreplicated_baseline_mode():
+    """emulateUnreplicated analog (PaxosManager.java:1751-1799): entry
+    executes + responds with NO coordination — zero ticks needed."""
+    import numpy as np
+
+    from gigapaxos_tpu.config import GigapaxosTpuConfig
+    from gigapaxos_tpu.models.dense_apps import DenseCounterApp
+    from gigapaxos_tpu.paxos.manager import PaxosManager
+
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 8
+    cfg.paxos.compact_outbox = True
+    cfg.paxos.emulate_unreplicated = True
+    apps = [DenseCounterApp(8) for _ in range(3)]
+    m = PaxosManager(cfg, 3, apps)
+    for a in apps:
+        a.row_of = m.rows.row
+    assert m.create_paxos_instances([f"u{i}" for i in range(4)], [0, 1, 2]) == 4
+    rows = np.array([m.rows.row(f"u{i}") for i in range(4)])
+    got = {}
+    import struct
+
+    rids = m.propose_bulk(rows, [struct.pack("<q", 5)] * 4,
+                          callbacks=[
+                              (lambda rid, r, i=i: got.__setitem__(i, r))
+                              for i in range(4)])
+    # responses fired inline, no tick ever ran
+    assert (rids >= 0).all()
+    assert len(got) == 4 and m.tick_num == 0
+    assert m.stats["decisions"] == 4
+    # exactly ONE replica executed each request (nothing replicated)
+    total = sum(int(a.count.sum()) for a in apps)
+    assert total == 4
+
+
+def test_lazy_propagation_baseline_mode():
+    """emulateLazyPropagation/EXECUTE_UPON_ACCEPT analog: entry responds
+    immediately; consensus still converges the other replicas, with no
+    double execution at the entry."""
+    import struct
+
+    import numpy as np
+
+    from gigapaxos_tpu.config import GigapaxosTpuConfig
+    from gigapaxos_tpu.models.dense_apps import DenseCounterApp
+    from gigapaxos_tpu.paxos.manager import PaxosManager
+
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 8
+    cfg.paxos.compact_outbox = True
+    cfg.paxos.lazy_propagation = True
+    apps = [DenseCounterApp(8) for _ in range(3)]
+    m = PaxosManager(cfg, 3, apps)
+    for a in apps:
+        a.row_of = m.rows.row
+    assert m.create_paxos_instances([f"z{i}" for i in range(4)], [0, 1, 2]) == 4
+    rows = np.array([m.rows.row(f"z{i}") for i in range(4)])
+    got = {}
+    m.propose_bulk(rows, [struct.pack("<q", 3)] * 4,
+                   callbacks=[(lambda rid, r, i=i: got.__setitem__(i, r))
+                              for i in range(4)])
+    # the entry executed eagerly (before any commit)
+    assert sum(int(a.count.sum()) for a in apps) == 4
+    for _ in range(12):
+        m.tick()
+    m.drain_pipeline()
+    # responses arrived; all replicas converged; EXACTLY R executions per
+    # request overall (the eager entry execution replaced its commit-time
+    # one, not duplicated it)
+    assert len(got) == 4
+    for a in apps:
+        assert (a.acc[rows] == 3).all()
+        assert (a.count[rows] == 1).all()

@@ -14,6 +14,7 @@ anywhere in this file).
 
 import time
 
+import numpy as np
 import pytest
 
 from gigapaxos_tpu.client import ClientError, ReconfigurableAppClient
@@ -56,6 +57,51 @@ def _request_via(client, name, payload, active, timeout=30.0):
             break
         time.sleep(0.5)
     raise AssertionError(f"request via {active} failed: {box}")
+
+
+def _dump_cp_state(srv, name, got, want) -> str:
+    """Post-mortem for convergence stalls: every RC's record view + RC/AR
+    plane health, so a CI failure names the wedged component instead of
+    'actives never converged'."""
+    lines = [f"actives for {name!r}: got {sorted(got)} want {sorted(want)}"]
+    for nid, s in srv.items():
+        try:
+            if s.reconfigurator is not None:
+                rec = s.reconfigurator.db.get(name)
+                rc = s.rc_node
+                lines.append(
+                    f"  {nid}: rec={{state: {getattr(rec, 'state', None)}, "
+                    f"epoch: {getattr(rec, 'epoch', None)}, "
+                    f"actives: {getattr(rec, 'actives', None)}, "
+                    f"new: {getattr(rec, 'new_actives', None)}}} "
+                    f"rc_plane={{ticks: {rc.tick_num}, "
+                    f"alive: {list(map(bool, rc.alive))}, "
+                    f"queued: {sum(map(len, rc._queues.values()))}, "
+                    f"outstanding: {len(rc.outstanding)}, "
+                    f"stalled: {len(rc._stalled)}, "
+                    f"tainted: {len(rc._tainted_rows)}, "
+                    f"decisions: {rc.stats['decisions']}, "
+                    f"rerouted: {rc.stats['rerouted']}, "
+                    f"coord_view: {sorted(set(int(x) for x in rc._coord_view[:8]))}}}"
+                )
+            if s.node is not None:
+                n = s.node
+                lines.append(
+                    f"  {nid}(ar): ticks={n.tick_num} "
+                    f"alive={list(map(bool, n.alive))} "
+                    f"epochs={dict(s.coordinator._epoch)} "
+                    f"rows={dict(n.rows.items())} "
+                    f"stopped={sorted(n._stopped_rows)} "
+                    f"tainted={sorted(n._tainted_rows)} "
+                    f"decisions={n.stats['decisions']} "
+                    f"ckpt_req={n.stats['ckpt_requests']} "
+                    f"ckpt_xfer={n.stats['ckpt_transfers']} "
+                    f"exec={np.asarray(n.state.exec_slot[n.r])[:6].tolist()} "
+                    f"db={dict(getattr(s.app, 'db', {}))}"
+                )
+        except Exception as e:  # the dump must never mask the real failure
+            lines.append(f"  {nid}: dump failed: {type(e).__name__}: {e}")
+    return "\n".join(lines)
 
 
 def _free_port() -> int:
@@ -148,9 +194,15 @@ def test_migrate_preserves_state_across_processes(servers, client):
         if got == set(new):
             break
         time.sleep(0.3)
-    assert got == set(new)
-    assert client.request("mig", b"GET city", timeout=60) == b"amherst"
-    assert client.request("mig", b"PUT t 2", timeout=60) == b"OK"
+    assert got == set(new), _dump_cp_state(srv, "mig", got, new)
+    try:
+        assert client.request("mig", b"GET city", timeout=60) == b"amherst"
+        assert client.request("mig", b"PUT t 2", timeout=60) == b"OK"
+    except (TimeoutError, ClientError, AssertionError) as e:
+        raise AssertionError(
+            f"post-migration request failed: {e}\n"
+            + _dump_cp_state(srv, "mig", got, new)
+        ) from e
     # the newcomer's own app copy converges (its independent plane learned
     # by state transfer, not shared memory)
     nc = newcomer[0]
@@ -160,7 +212,8 @@ def test_migrate_preserves_state_across_processes(servers, client):
         if db.get("mig#1", {}).get("city") == "amherst":
             break
         time.sleep(0.1)
-    assert srv[nc].app.db.get("mig#1", {}).get("city") == "amherst"
+    assert srv[nc].app.db.get("mig#1", {}).get("city") == "amherst", \
+        _dump_cp_state(srv, "mig", got, new)
 
 
 def test_delete_and_recreate(servers, client):

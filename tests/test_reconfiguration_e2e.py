@@ -167,3 +167,97 @@ def test_echo_rtt(cluster, client):
     a = client.request_actives("svc0")[0]
     rtt = client.echo(a)
     assert 0 <= rtt < 5
+
+
+def test_final_state_gc_starvation_heals_by_peer_repair(monkeypatch):
+    """Round-5 root cause of the migrate/recreate stalls: the complete
+    commits at a MAJORITY of AckStarts and WaitAckDropEpoch then GCs the
+    previous epoch, so a slow member's final-state fetch can find no donor
+    forever.  The fix: after a fruitless round past the give-up floor, the
+    member births the epoch EMPTY + TAINTED (refusing to serve or donate)
+    and the data plane's checkpoint transfer repairs it from a caught-up
+    member of the NEW epoch."""
+    import socket
+    import time
+
+    from gigapaxos_tpu.client import ReconfigurableAppClient
+    from gigapaxos_tpu.config import GigapaxosTpuConfig
+    from gigapaxos_tpu.reconfiguration import active_replica as arm
+    from gigapaxos_tpu.reconfiguration import packets as pkt
+    from gigapaxos_tpu.server import ModeBServer
+
+    monkeypatch.setattr(arm.WaitEpochFinalState, "give_up_floor_s", 0.5)
+
+    def fp():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 32
+    cfg.fd.ping_interval_s = 0.1
+    cfg.fd.timeout_s = 1.0
+    for i in range(4):
+        cfg.nodes.actives[f"AR{i}"] = ("127.0.0.1", fp())
+    cfg.nodes.reconfigurators["RC0"] = ("127.0.0.1", fp())
+    srv = {nid: ModeBServer(nid, cfg, start_fd=True)
+           for nid in list(cfg.nodes.actives) + ["RC0"]}
+    client = None
+    try:
+        for s in srv.values():
+            assert s.wait_ready(300)
+        client = ReconfigurableAppClient(cfg.nodes)
+        assert client.create("svc", timeout=60)["ok"]
+        assert client.request("svc", b"PUT city amherst", timeout=30) == b"OK"
+        old = set(client.request_actives("svc"))
+        newcomer = sorted(set(cfg.nodes.active_ids()) - old)[0]
+        new = sorted(sorted(old)[:2] + [newcomer])
+
+        # emulate the drop-GC race: every previous active reports the
+        # final state GONE (as if WaitAckDropEpoch already ran — a plain
+        # found=False without gone means "not stopped yet" and the asker
+        # correctly keeps polling instead of giving up)
+        def deny(ar):
+            def h(sender, p):
+                reply = pkt.epoch_final_state(p["name"], p["epoch"], None)
+                reply["gone"] = True
+                ar.m.send(p["requester"], reply)
+            return h
+
+        for nid in old:
+            ar = srv[nid].active_replica
+            ar.m.register(pkt.REQUEST_EPOCH_FINAL_STATE, deny(ar))
+        assert client.reconfigure("svc", new, timeout=120)["ok"]
+
+        deadline = time.monotonic() + 120
+        val = None
+        while time.monotonic() < deadline:
+            try:
+                val = client.request("svc", b"GET city", timeout=10)
+                if val == b"amherst":
+                    break
+            except (TimeoutError, Exception):
+                pass
+            time.sleep(0.5)
+        assert val == b"amherst", val
+
+        # the starved member repaired from a NEW-epoch peer: taint gone,
+        # real state present
+        nc = srv[newcomer]
+        deadline = time.monotonic() + 120
+        repaired = False
+        while time.monotonic() < deadline and not repaired:
+            row = nc.node.rows.row("svc#1")
+            repaired = (
+                row is not None and row not in nc.node._tainted_rows
+                and nc.app.db.get("svc#1", {}).get("city") == "amherst"
+            )
+            time.sleep(0.5)
+        assert repaired, (dict(nc.app.db), sorted(nc.node._tainted_rows))
+    finally:
+        if client is not None:
+            client.close()
+        for s in srv.values():
+            s.close()

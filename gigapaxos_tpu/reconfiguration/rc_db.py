@@ -58,6 +58,18 @@ class ReconfiguratorDB(Replicable):
     def __init__(self, node_id: str = "?"):
         self.node_id = node_id
         self.records: Dict[str, ReconfigurationRecord] = {}
+        #: deleted names -> their final epoch (reincarnation tombstones).
+        #: A recreate continues at tombstone+1 so the OLD incarnation's
+        #: still-in-flight DropEpoch (async AR-side GC) can never address —
+        #: and destroy — the new incarnation's data-plane group.  The
+        #: reference retains deleted records for MAX_FINAL_STATE the same
+        #: way.  Applied inside the replicated command stream, so every RC
+        #: replica derives identical epochs.  Never evicted: any
+        #: size-triggered eviction order would depend on how THIS node's
+        #: several RC groups' command streams interleaved locally —
+        #: non-deterministic across replicas — and a tombstone is ~50
+        #: bytes per deleted name ever (recreates reclaim theirs).
+        self.tombstones: Dict[str, int] = {}
         self._lock = threading.RLock()
         #: called (command_dict, record_dict_or_none) after each apply
         self.listener: Optional[Callable[[dict, Optional[dict]], None]] = None
@@ -119,6 +131,17 @@ class ReconfiguratorDB(Replicable):
             rec.actives = sorted(pool)
             rec.epoch += 1
             return {"ok": True, "pool": rec.actives, "epoch": rec.epoch}
+        if op == "tombstone_install":
+            # idempotent tombstone carry-over into a re-homed RC group (the
+            # record_install twin for deleted names)
+            if rec is not None:
+                # the name was recreated meanwhile: its live record already
+                # supersedes the tombstone
+                return {"ok": True, "installed": False}
+            ep = int(cmd["epoch"])
+            if self.tombstones.get(name, -1) < ep:
+                self.tombstones[name] = ep
+            return {"ok": True, "installed": True}
         if op == "record_install":
             # idempotent record carry-over into a re-homed RC group after a
             # ring splice (the reference re-hashes record ownership the same
@@ -184,25 +207,37 @@ class ReconfiguratorDB(Replicable):
             if rec is not None:
                 return {"ok": False, "error": "exists", "epoch": rec.epoch}
             rec = ReconfigurationRecord(
-                name=name, epoch=int(cmd.get("epoch", 0)),
+                name=name,
+                epoch=max(int(cmd.get("epoch", 0)),
+                          self.tombstones.pop(name, -1) + 1),
                 actives=sorted(cmd["actives"]),
             )
             self.records[name] = rec
+            # side-channel for the commit LISTENER (same decoded dict): the
+            # backup creation drivers must fire ONLY for names this command
+            # actually created — an "exists" name's record belongs to a
+            # live (possibly reconfigured) incarnation that a stale-state
+            # creation StartEpoch would clobber
+            cmd["_created"] = {name: rec.epoch}
             return {"ok": True, "epoch": rec.epoch}
         if op == "create_batch":
             # one committed command creates every record of the batch
             # (BatchedCreateServiceName.java applied atomically per RC group)
             results = {}
+            created = {}
             for c in cmd.get("creates", []):
                 n = c["name"]
                 if n in self.records:
                     results[n] = {"ok": False, "error": "exists",
                                   "epoch": self.records[n].epoch}
                 else:
+                    ep = self.tombstones.pop(n, -1) + 1
                     self.records[n] = ReconfigurationRecord(
-                        name=n, epoch=0, actives=sorted(c["actives"]),
+                        name=n, epoch=ep, actives=sorted(c["actives"]),
                     )
-                    results[n] = {"ok": True, "epoch": 0}
+                    results[n] = {"ok": True, "epoch": ep}
+                    created[n] = ep
+            cmd["_created"] = created  # see the "create" op's note
             return {"ok": True, "results": results}
         if rec is None:
             return {"ok": False, "error": "unknown"}
@@ -228,6 +263,7 @@ class ReconfiguratorDB(Replicable):
             if rec.state != RCState.WAIT_DELETE:
                 return {"ok": False, "error": "wrong_state",
                         "state": rec.state.value}
+            self.tombstones[name] = rec.epoch
             del self.records[name]
             return {"ok": True}
         return {"ok": False, "error": f"bad op {op}"}
@@ -238,8 +274,15 @@ class ReconfiguratorDB(Replicable):
     def checkpoint(self, name: str) -> bytes:
         with self._lock:
             return json.dumps({
-                n: r.to_dict() for n, r in self.records.items()
-                if self._in_scope(n, name)
+                "__rcdb__": 2,
+                "recs": {
+                    n: r.to_dict() for n, r in self.records.items()
+                    if self._in_scope(n, name)
+                },
+                "tombs": {
+                    n: e for n, e in self.tombstones.items()
+                    if self._in_scope(n, name)
+                },
             }).encode()
 
     def restore(self, name: str, state: bytes) -> None:
@@ -248,12 +291,23 @@ class ReconfiguratorDB(Replicable):
                 n: r for n, r in self.records.items()
                 if not self._in_scope(n, name)
             }
+            kept_t = {
+                n: e for n, e in self.tombstones.items()
+                if not self._in_scope(n, name)
+            }
             if state:
+                d = json.loads(state.decode())
+                if isinstance(d, dict) and d.get("__rcdb__") == 2:
+                    recs, tombs = d["recs"], d.get("tombs", {})
+                else:  # pre-tombstone checkpoint: flat record map
+                    recs, tombs = d, {}
                 kept.update({
-                    n: ReconfigurationRecord.from_dict(d)
-                    for n, d in json.loads(state.decode()).items()
+                    n: ReconfigurationRecord.from_dict(rd)
+                    for n, rd in recs.items()
                 })
+                kept_t.update({n: int(e) for n, e in tombs.items()})
             self.records = kept
+            self.tombstones = kept_t
 
 
 class RepliconfigurableReconfiguratorDB:

@@ -365,12 +365,15 @@ class Reconfigurator:
                     "ok": True, "actives": actives,
                 })
 
+            # a recreated name continues at tombstone+1 (rc_db): the old
+            # incarnation's still-in-flight DropEpoch must never be able
+            # to address the new incarnation's data-plane group
+            ep = int(result.get("epoch", 0))
             # a stale backup task from a previous incarnation of this name
-            # (deleted then recreated at epoch 0) would block this key and
-            # orphan the client response — evict it first
-            self.executor.cancel(f"WaitAckStartEpoch:{name}:0")
+            # would block this key and orphan the client response
+            self.executor.cancel(f"WaitAckStartEpoch:{name}:{ep}")
             self.executor.schedule(WaitAckStartEpoch(
-                self, name, 0, actives, -1, [], state, started
+                self, name, ep, actives, -1, [], state, started
             ))
 
         # origin + initial_state ride inside the replicated command so any
@@ -474,9 +477,10 @@ class Reconfigurator:
                     if not r.get("ok"):
                         name_done(n, dict(r))
                         continue
-                    self.executor.cancel(f"WaitAckStartEpoch:{n}:0")
+                    ep = int(r.get("epoch", 0))
+                    self.executor.cancel(f"WaitAckStartEpoch:{n}:{ep}")
                     self.executor.schedule(WaitAckStartEpoch(
-                        self, n, 0, e["actives"], -1, [],
+                        self, n, ep, e["actives"], -1, [],
                         pkt.b64d(e["initial_state"]) or b"",
                         lambda n=n, e=e: name_done(
                             n, {"ok": True, "actives": e["actives"]}
@@ -959,6 +963,24 @@ class Reconfigurator:
                 installed, proposer=self.node_id,
             )
             issued += 1
+        # reincarnation tombstones re-home too: without them a recreate in
+        # the new group would restart at epoch 0 and the old incarnation's
+        # late DropEpoch could destroy it (see rc_db tombstones)
+        for name, ep in list(self.db.tombstones.items()):
+            key = (pool_key, name, "tomb", ep)
+            if key in self._rc_migrated:
+                continue
+
+            def t_installed(result: dict, key=key) -> None:
+                if result.get("ok"):
+                    self._rc_migrated.add(key)
+
+            self.rdb.commit(
+                name,
+                {"op": "tombstone_install", "name": name, "epoch": ep},
+                t_installed, proposer=self.node_id,
+            )
+            issued += 1
         return issued
 
     # --------------------------------------------------------- commit events
@@ -997,12 +1019,18 @@ class Reconfigurator:
         elif op == "create_batch":
             if cmd.get("origin") == self.node_id:
                 return
+            created = cmd.get("_created") or {}
             for c in cmd.get("creates", []):
                 n = c["name"]
+                if n not in created:
+                    # "exists" outcome: the record belongs to a live
+                    # incarnation — a creation StartEpoch with the batch's
+                    # stale initial_state would clobber it
+                    continue
                 if self.node_id not in self.rdb.rc_group_of(n):
                     continue
                 t = WaitAckStartEpoch(
-                    self, n, 0, c["actives"], -1, [],
+                    self, n, created[n], c["actives"], -1, [],
                     pkt.b64d(c.get("initial_state")) or b"", None,
                 )
                 t.first_delayed = True
@@ -1010,10 +1038,13 @@ class Reconfigurator:
                 self.executor.cancel(t.key)
                 self.executor.schedule(t)
         elif op == "create" and record is not None:
-            if in_group and cmd.get("origin") != self.node_id:
+            if (in_group and cmd.get("origin") != self.node_id
+                    and name in (cmd.get("_created") or {})):
                 # backup creation driver: if the origin RC dies before its
                 # StartEpochs go out, this (delayed, idempotent) task still
-                # births the name's epoch-0 group
+                # births the created group.  Gated on _created: an "exists"
+                # outcome's record belongs to a live incarnation that this
+                # command's stale initial_state must never touch
                 t = WaitAckStartEpoch(
                     self, name, record["epoch"], record["actives"], -1, [],
                     pkt.b64d(cmd.get("initial_state")) or b"", None,

@@ -261,3 +261,85 @@ def test_final_state_gc_starvation_heals_by_peer_repair(monkeypatch):
             client.close()
         for s in srv.values():
             s.close()
+
+
+def test_recreate_survives_stale_drop_of_old_incarnation():
+    """Reincarnation safety (round-5 root cause of the delete/recreate
+    stalls): a recreated name continues at tombstone+1, so the OLD
+    incarnation's still-in-flight DropEpoch — delivered arbitrarily late —
+    addresses a different data-plane group and can never destroy the new
+    incarnation."""
+    import socket
+    import time
+
+    from gigapaxos_tpu.client import ReconfigurableAppClient
+    from gigapaxos_tpu.config import GigapaxosTpuConfig
+    from gigapaxos_tpu.reconfiguration import packets as pkt
+    from gigapaxos_tpu.server import ModeBServer
+
+    def fp():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 32
+    cfg.fd.ping_interval_s = 0.1
+    cfg.fd.timeout_s = 1.0
+    for i in range(3):
+        cfg.nodes.actives[f"AR{i}"] = ("127.0.0.1", fp())
+    cfg.nodes.reconfigurators["RC0"] = ("127.0.0.1", fp())
+    srv = {nid: ModeBServer(nid, cfg, start_fd=True)
+           for nid in list(cfg.nodes.actives) + ["RC0"]}
+    client = None
+    try:
+        for s in srv.values():
+            assert s.wait_ready(300)
+        client = ReconfigurableAppClient(cfg.nodes)
+        assert client.create("re", timeout=60)["ok"]
+        assert client.request("re", b"PUT x 1", timeout=30) == b"OK"
+
+        # hold back DROP_EPOCH delivery on every AR: the delete's GC stays
+        # "in flight" past the recreate (the late-drop race, made certain)
+        held = []
+
+        def holder(ar):
+            orig = ar._on_drop_epoch
+
+            def h(sender, p):
+                held.append((orig, sender, p))
+            return h
+
+        for i in range(3):
+            ar = srv[f"AR{i}"].active_replica
+            ar.m.register(pkt.DROP_EPOCH, holder(ar))
+
+        # the drop task wants ALL acks but ages out (~8s,
+        # WaitAckDropEpoch.max_restarts) and completes the delete anyway —
+        # exactly the window where a recreate races the still-held drops
+        assert client.delete("re", timeout=60)["ok"]
+        assert client.create("re", timeout=60)["ok"]  # reincarnation
+        assert client.request("re", b"PUT y 2", timeout=30) == b"OK"
+        # every AR hosts the NEW incarnation at epoch tombstone+1 (> 0)
+        for i in range(3):
+            co = srv[f"AR{i}"].coordinator
+            ep = co.current_epoch("re")
+            assert ep is not None and ep >= 1, (i, ep)
+
+        # now deliver the stale drops of the old incarnation
+        for orig, sender, p in held:
+            orig(sender, p)
+        time.sleep(1.0)
+        # the new incarnation survived: same epoch, data intact, still serving
+        for i in range(3):
+            co = srv[f"AR{i}"].coordinator
+            assert co.current_epoch("re") is not None, i
+        assert client.request("re", b"GET y", timeout=30) == b"2"
+        assert client.request("re", b"GET x", timeout=30) == b"NF"  # new life
+    finally:
+        if client is not None:
+            client.close()
+        for s in srv.values():
+            s.close()

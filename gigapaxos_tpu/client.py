@@ -91,6 +91,11 @@ class ReconfigurableAppClient:
         # randomized like _next_rid: a restarted client with a stable id
         # must not hit the server's batch-dedup cache from its past life
         self._next_bid = random.randrange(1, 1 << 30)
+        #: bid -> (target, send time): one RTT sample per batch FRAME (the
+        #: per-rid _sent_at writes were the staging hot path's top cost)
+        self._batch_sent: "collections.OrderedDict[int, tuple]" = (
+            collections.OrderedDict()
+        )
 
     def close(self) -> None:
         self.m.close()
@@ -342,9 +347,24 @@ class ReconfigurableAppClient:
         self.m.send(target, self._stamp(pkt.app_request(name, payload, rid)))
         return rid
 
+    def _batch_rtt(self, bid) -> None:
+        """Per-frame RTT sample for the redirector's EWMA."""
+        ent = None
+        with self._lock:
+            ent = self._batch_sent.pop(bid, None)
+        if ent is None:
+            return
+        target, t0 = ent
+        rtt = time.monotonic() - t0
+        with self._lock:
+            prev = self._rtt.get(target)
+            self._rtt[target] = (rtt if prev is None
+                                 else 0.875 * prev + 0.125 * rtt)
+
     def _on_batch_response(self, sender: str, p: dict) -> None:
         """Fan a batched response frame back out to the per-rid callbacks
         (same completion semantics as APP_RESPONSE, one frame for all)."""
+        self._batch_rtt(p.get("bid"))
         for rid, ok, body in p.get("results") or []:
             if ok:
                 self._on_response(sender, {"type": pkt.APP_RESPONSE,
@@ -383,13 +403,24 @@ class ReconfigurableAppClient:
                     self._callbacks.pop(r, None)
                     self._cb_deadline.pop(r, None)
                     self._sent_at.pop(r, None)
+            ttl = now + self._cb_ttl_s
             for target, reqs in by_target.items():
                 for _name, rid, _p in reqs:
                     self._callbacks[rid] = callback
-                    self._cb_deadline[rid] = now + self._cb_ttl_s
-                    self._sent_at[rid] = (target, now)
+                    self._cb_deadline[rid] = ttl
+                    # no per-rid _sent_at: batch responses arrive as one
+                    # columnar frame — per-request RTT attribution would
+                    # cost a dict write per request on the hot path for a
+                    # signal the redirector only needs per target
             bid = self._next_bid
             self._next_bid += len(by_target)
+            # ONE per-frame RTT sample per target instead: batched traffic
+            # must keep feeding the redirector's EWMA or a once-penalized
+            # (since recovered) replica could never be re-measured
+            for i, target in enumerate(by_target):
+                self._batch_sent[bid + i] = (target, now)
+            while len(self._batch_sent) > 1024:
+                self._batch_sent.popitem(last=False)
         return by_target, rids, bid
 
     def send_request_batch(
@@ -512,6 +543,7 @@ class ReconfigurableAppClient:
         """Columnar response frame -> per-rid callbacks.  One lock
         acquisition covers the whole frame's bookkeeping."""
         _bid, rids, statuses, bodies = binbatch.decode_response(buf)
+        self._batch_rtt(_bid)
         fire = []
         with self._lock:
             for rid, ok, body in zip(rids, statuses, bodies):

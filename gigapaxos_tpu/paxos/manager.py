@@ -145,6 +145,12 @@ class PaxosManager:
         self._bulk_cap = 1 << (bc - 1).bit_length()
         self.bulk: Optional[BulkStore] = None  # lazy (most managers: unused)
         self._bulk_cbs: Dict[int, Callable] = {}  # optional per-rid cbs
+        #: columnar completion sinks: one per admitted contiguous rid
+        #: block — [rid0, rid0+n) -> sink(offsets, responses) called in
+        #: per-tick batches instead of one Python callback per request
+        #: (the completion-side twin of propose_bulk's columnar admission).
+        #: Kept sorted by rid0; vectorized lookup via searchsorted.
+        self._sink_blocks: list = []  # [rid0, n, remaining, sink]
         self._bulk_chunks: list = []  # FIFO of staged rid arrays
         self._bulk_leftover = np.zeros(0, np.int64)  # queued, not yet placed
         self._bulk_placed = None  # (rids, entries, ps, rows) of last tick
@@ -332,7 +338,7 @@ class PaxosManager:
         if self.bulk is not None:
             gone = np.nonzero(self.bulk.valid & (self.bulk.row == row))[0]
             if len(gone):
-                if self._bulk_cbs:
+                if self._bulk_cbs or self._sink_blocks:
                     self._bulk_fire(
                         self.bulk.rid[gone[~self.bulk.responded[gone]]]
                     )
@@ -651,7 +657,8 @@ class PaxosManager:
 
     @_locked
     def propose_bulk(self, rows, payloads, stops=None,
-                     callbacks=None, entries=None) -> np.ndarray:
+                     callbacks=None, entries=None,
+                     batch_sink=None) -> np.ndarray:
         """Vectorized propose: admit one request per entry of ``rows`` (row
         indices into the group table) in a single columnar operation.
 
@@ -735,13 +742,25 @@ class PaxosManager:
             # measurement baseline (emulateUnreplicated,
             # PaxosManager.java:1751-1799): execute at the entry replica NOW,
             # respond, touch nothing else — no store, no tick, no journal
-            self._baseline_exec(rows, ent, payloads, rid0 + np.arange(
-                n_adm, dtype=np.int64), callbacks, eager_fire=True)
+            resps = self._baseline_exec(
+                rows, ent, payloads, rid0 + np.arange(n_adm, dtype=np.int64),
+                callbacks, eager_fire=True,
+            )
+            if batch_sink is not None:
+                # inline columnar delivery: no tick ever runs to route a
+                # sink block, and the baseline has no durability to gate
+                batch_sink(np.arange(n_adm, dtype=np.int64), resps)
             self.stats["decisions"] += n_adm
             out[np.nonzero(ok)[0][:n_adm]] = rid0 + np.arange(n_adm)
             return out
         rids = store.admit(rid0, rows.astype(np.int32), ent, stops,
                            payloads)
+        if batch_sink is not None:
+            # columnar completion: ONE sink call per tick delivers this
+            # block's finished (offset, response) columns — no per-request
+            # callback objects anywhere.  offsets are rid - rid0, i.e. the
+            # caller's admitted-item order.
+            self._sink_blocks.append([rid0, n_adm, n_adm, batch_sink])
         if callbacks is not None:
             for rid, cb in zip(rids, callbacks):
                 if cb is not None:
@@ -763,11 +782,12 @@ class PaxosManager:
         return out
 
     def _baseline_exec(self, rows, ent, payloads, rids, callbacks,
-                       eager_fire: bool, store_idx=None) -> None:
+                       eager_fire: bool, store_idx=None) -> list:
         """Entry-replica immediate execution for the two measurement
         baselines.  With ``store_idx`` (lazy mode) the store's entry exec
         bit + responded flag are pre-set so commit-time execution skips
-        the entry replica and never re-responds."""
+        the entry replica and never re-responds.  Returns the responses
+        aligned with the input order (b"" where the app returned none)."""
         if isinstance(payloads, (bytes, bytearray)):
             pa = np.empty(len(rows), object)
             pa[:] = bytes(payloads)
@@ -775,6 +795,7 @@ class PaxosManager:
         payloads = np.asarray(payloads, object)
         rows = np.asarray(rows, np.int64)
         eager: list = []
+        out_resps: list = [b""] * len(rows)
         for r in range(self.R):
             sel = ent == r
             if not sel.any():
@@ -795,28 +816,33 @@ class PaxosManager:
                     ra = np.empty(len(si), object)
                     ra[:] = resp
                     self.bulk.response[si] = ra
-                if self._bulk_cbs:
+                if self._bulk_cbs or self._sink_blocks:
                     self._bulk_fire(rids[sel],
                                     resp if resp is not None
                                     else [b""] * int(sel.sum()))
-            elif eager_fire and callbacks is not None:
+            else:
                 for pos, j in enumerate(np.nonzero(sel)[0]):
-                    cb = callbacks[j]
-                    if cb is not None:
-                        r_j = resp[pos] if resp is not None else b""
+                    r_j = resp[pos] if resp is not None else b""
+                    out_resps[j] = r_j or b""
+                    if eager_fire and callbacks is not None \
+                            and callbacks[j] is not None:
                         # fired inline below — NEVER through the shared
                         # durability-gated queue, whose other occupants
                         # must keep waiting for their WAL sync
-                        eager.append((cb, int(rids[j]), r_j or b""))
+                        eager.append((callbacks[j], int(rids[j]),
+                                      r_j or b""))
         if eager_fire:
             # the unreplicated baseline responds inline (no durability by
             # definition)
             for cb, rid, resp in eager:
                 cb(rid, resp)
+        return out_resps
 
     def _bulk_fire(self, rids, responses=None) -> None:
         """Queue completion callbacks for bulk rids that just reached their
         responded transition (durability-gated like every response)."""
+        if self._sink_blocks:
+            self._sink_route(rids, responses)
         if not self._bulk_cbs:
             return
         if responses is None:
@@ -836,6 +862,44 @@ class PaxosManager:
                         # device-app responses are i32 scalars
                         resp = _struct.pack("<i", int(resp))
                     self._held_callbacks.append((cb, int(rid), resp))
+
+    def _sink_route(self, rids, responses) -> None:
+        """Deliver completions to their rid-block sinks: vectorized block
+        lookup, ONE durability-gated thunk per (sink, fire) instead of a
+        Python callback per request.  ``responses`` None = failure."""
+        a = np.asarray(rids, np.int64)
+        if a.size == 0:
+            return
+        blocks = self._sink_blocks
+        starts = np.fromiter((b[0] for b in blocks), np.int64,
+                             count=len(blocks))
+        bi = np.searchsorted(starts, a, "right") - 1
+        gc = False
+        for k in np.unique(bi):
+            if k < 0:
+                continue
+            blk = blocks[k]
+            sel = bi == k
+            offs = (a[sel] - blk[0])
+            inside = offs < blk[1]
+            if not inside.any():
+                continue
+            offs = offs[inside]
+            if responses is None:
+                resp_sel = None
+            else:
+                idx = np.nonzero(sel)[0][inside]
+                resp_sel = [responses[i] for i in idx]
+            blk[2] -= len(offs)
+            gc = gc or blk[2] <= 0
+            sink = blk[3]
+
+            def fire(_rid, _resp, s=sink, o=offs, rr=resp_sel):
+                s(o, rr)
+
+            self._held_callbacks.append((fire, -1, None))
+        if gc:
+            self._sink_blocks = [b for b in blocks if b[2] > 0]
 
     @_locked
     def propose_bulk_kv(self, rows, ops, keys, vals,
@@ -1033,7 +1097,7 @@ class PaxosManager:
         # rows gone dead under queued requests (removed/stopped): drop them
         bad = (self._n_members_np[rows] == 0) | self._stopped_np[rows]
         if bad.any():
-            if self._bulk_cbs:
+            if self._bulk_cbs or self._sink_blocks:
                 self._bulk_fire(q[bad])  # group gone: cb(None), client retries
             store.fail(idx[bad])
             self.stats["failed_requests"] += int(bad.sum())
@@ -1326,9 +1390,9 @@ class PaxosManager:
             s.response[sidx] = resp
             if desc_lost:
                 self.stats["failed_requests"] += 1
-                if self._bulk_cbs:
+                if self._bulk_cbs or self._sink_blocks:
                     self._bulk_fire([rid])  # cb(None): client-visible failure
-            elif self._bulk_cbs:
+            elif self._bulk_cbs or self._sink_blocks:
                 self._bulk_fire([rid], [resp if resp is not None else b""])
         full = self._member_bits[row]
         if s.responded[sidx] and (s.exec_mask[sidx] & full) == full:
@@ -1451,9 +1515,9 @@ class PaxosManager:
                         ra = np.empty(len(resp), object)
                         ra[:] = resp
                         store.response[ri] = ra[em]
-                        if self._bulk_cbs:
+                        if self._bulk_cbs or self._sink_blocks:
                             self._bulk_fire(store.rid[ri], list(ra[em]))
-                    elif self._bulk_cbs:
+                    elif self._bulk_cbs or self._sink_blocks:
                         self._bulk_fire(store.rid[ri],
                                         [b""] * len(ri))
                 touched.append(idx_r)
@@ -1577,7 +1641,7 @@ class PaxosManager:
                 s.exec_mask[sel] |= np.int64(1) << r
                 ent = (s.entry[sel] == r) & ~s.responded[sel]
                 s.responded[sel[ent]] = True
-                if self._bulk_cbs and ent.any():
+                if (self._bulk_cbs or self._sink_blocks) and ent.any():
                     self._bulk_fire(s.rid[sel[ent]])  # duty skipped: None
                 s.free_done(sel, self._member_bits[s.row[sel]])
         self.stats["checkpoint_transfers"] += 1

@@ -34,6 +34,7 @@ import numpy as np
 from ..net import binbatch
 from ..net.bulk import BulkTransfer
 from ..net.messenger import Messenger
+from ..net.transport import SendFailure
 from ..protocoltask.executor import ProtocolExecutor, ProtocolTask
 from . import packets as pkt
 from .consistent_hashing import ConsistentHashRing
@@ -371,7 +372,11 @@ class ActiveReplica:
                 else:
                     self._req_dedup.pop(key, None)
                 self._dedup_born.pop(key, None)
-            self.m.send(reply_to, resp)
+            try:
+                self.m.send(reply_to, resp)
+            except SendFailure:
+                pass  # client/transport gone: completions delivered on the
+                # tick thread must never kill the driver
 
         def settle(i: int, rid, entry) -> None:
             results[i] = entry
@@ -469,7 +474,13 @@ class ActiveReplica:
                 else:
                     self._req_dedup.pop(key, None)
                 self._dedup_born.pop(key, None)
-            self.m.send_bytes(client_id, frame)
+            try:
+                self.m.send_bytes(client_id, frame)
+            except SendFailure:
+                # client/transport gone (shutdown): completions delivered
+                # through the tick thread must never kill the driver;
+                # the response is simply undeliverable
+                pass
 
         def settle(i: int, ok: bool, body: bytes) -> None:
             statuses[i] = 1 if ok else 0
@@ -498,13 +509,18 @@ class ActiveReplica:
 
         try:
             crb = getattr(self.coord, "coordinate_requests_batch", None)
+            use_sink = (crb is not None
+                        and getattr(self.coord, "supports_batch_sink", False))
             items, live_idx = [], []
             for i in range(n):
                 ep = epochs[name_idx[i]]
                 if ep is None:
                     settle(i, False, b"not_active")
                     continue
-                if crb is not None:
+                if use_sink:
+                    items.append((names[name_idx[i]], ep, payloads[i], None))
+                    live_idx.append(i)
+                elif crb is not None:
                     items.append((names[name_idx[i]], ep, payloads[i],
                                   make_cb(i)))
                     live_idx.append(i)
@@ -515,7 +531,54 @@ class ActiveReplica:
                     )
                     if r is None:
                         settle(i, False, b"not_active")
-            if items:
+            if items and use_sink:
+                # columnar completion: the manager delivers (offsets,
+                # responses) per tick for the admitted block — zero
+                # per-request callback objects on this edge.  Early fires
+                # (completion racing this thread's index build) buffer.
+                admitted: list = []
+                early: list = []
+                built = [False]
+
+                def deliver(offs, resps) -> None:
+                    fin = False
+                    with lock:
+                        for k2, off in enumerate(offs):
+                            i2 = admitted[off]
+                            r2 = None if resps is None else resps[k2]
+                            if r2 is None:
+                                # same semantics as the per-rid callback
+                                # path: a None response is a retryable
+                                # failure, never an empty success
+                                bodies[i2] = b"stopped"
+                            else:
+                                statuses[i2] = 1
+                                bodies[i2] = r2
+                        remaining[0] -= len(offs)
+                        fin = remaining[0] == 0
+                    if fin:
+                        finish()
+
+                def sink(offs, resps) -> None:
+                    with lock:
+                        if not built[0]:
+                            early.append((offs, resps))
+                            return
+                    deliver(offs, resps)
+
+                out = crb(items, entry=self.node_id, batch_sink=sink)
+                for j, r2 in enumerate(out):
+                    i = live_idx[j]
+                    if r2 < 0:
+                        settle(i, False, _REJECT[min(-r2, 3)].encode())
+                    else:
+                        admitted.append(i)
+                with lock:
+                    built[0] = True
+                    drain, early[:] = early[:], []
+                for offs, resps in drain:
+                    deliver(offs, resps)
+            elif items:
                 out = crb(items, entry=self.node_id)
                 for i, r2 in zip(live_idx, out):
                     if r2 < 0:

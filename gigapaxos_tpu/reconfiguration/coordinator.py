@@ -144,7 +144,15 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
             self._pax_name(name, epoch), payload, callback, entry=slot
         )
 
-    def coordinate_requests_batch(self, items, entry: Optional[str] = None):
+    @property
+    def supports_batch_sink(self) -> bool:
+        """Columnar completion applies to the host-app bulk path; the
+        device app's responses already ride its packed tick columns
+        through per-rid callbacks."""
+        return not getattr(self.manager, "_device_app", False)
+
+    def coordinate_requests_batch(self, items, entry: Optional[str] = None,
+                                  batch_sink=None):
         """Batch twin of :meth:`coordinate_request` feeding the manager's
         vectorized propose path (one columnar admission for the whole
         frame instead of a per-request staged propose).
@@ -152,7 +160,13 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
         items: (name, epoch, payload, callback) tuples.  Returns a list of
         rids aligned with items (-1 = rejected: wrong epoch / unknown row /
         admission backpressure; no callback fires for those).
-        """
+
+        ``batch_sink(offsets, responses_or_None)``: columnar completion —
+        delivered in per-tick batches for the ADMITTED subset (offsets
+        index it in item order) instead of one Python callback per
+        request; per-item callbacks are ignored when a sink is given.
+        Host-app path only (the device path returns responses through its
+        own packed columns already)."""
         import numpy as np
 
         slot = self._slot.get(entry) if entry is not None else None
@@ -205,8 +219,9 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
                     )
             else:
                 out[sel] = self.manager.propose_bulk(
-                    rows[sel], sel_payloads, callbacks=sel_cbs,
-                    entries=slot,
+                    rows[sel], sel_payloads,
+                    callbacks=None if batch_sink is not None else sel_cbs,
+                    entries=slot, batch_sink=batch_sink,
                 )
         return list(out)
 

@@ -392,3 +392,36 @@ def test_lazy_propagation_baseline_mode():
     for a in apps:
         assert (a.acc[rows] == 3).all()
         assert (a.count[rows] == 1).all()
+
+
+def test_batch_sink_columnar_completion():
+    """propose_bulk(batch_sink=...) delivers (offsets, responses) per tick
+    for the admitted rid block — durability-gated, once per request, with
+    failure delivery (None responses) for a removed group."""
+    import numpy as np
+
+    from gigapaxos_tpu.config import GigapaxosTpuConfig
+    from gigapaxos_tpu.models.replicable import NoopApp
+    from gigapaxos_tpu.paxos.manager import PaxosManager
+
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 8
+    cfg.paxos.compact_outbox = True
+    m = PaxosManager(cfg, 3, [NoopApp() for _ in range(3)])
+    assert m.create_paxos_instances([f"s{i}" for i in range(4)], [0, 1, 2]) == 4
+    rows = np.array([m.rows.row(f"s{i}") for i in range(4)])
+    got = {}
+
+    def sink(offs, resps):
+        for k, off in enumerate(offs):
+            got[int(off)] = None if resps is None else resps[k]
+
+    rids = m.propose_bulk(np.repeat(rows, 2), [b"p%d" % i for i in range(8)],
+                          batch_sink=sink)
+    assert (rids >= 0).all()
+    for _ in range(12):
+        m.tick()
+    m.drain_pipeline()
+    assert sorted(got) == list(range(8)), got
+    assert all(v == b"ok:p%d" % i for i, v in got.items()), got
+    assert not m._sink_blocks  # fully-fired block GC'd

@@ -86,10 +86,18 @@ class _Peer:
     def __init__(self, transport: "Transport", dest: str):
         self.t = transport
         self.dest = dest
-        self.q: "queue.Queue[Tuple[int, bytes]]" = queue.Queue(
+        self.q: "queue.Queue[Tuple[int, int, bytes]]" = queue.Queue(
             maxsize=transport.send_queue_cap
         )
         self.sock: Optional[socket.socket] = None
+        #: bumped by Transport.reset_peer; frames are stamped with the
+        #: generation at enqueue, and the writer drops any frame — including
+        #: one it is holding mid-reconnect-retry — whose stamp is stale.
+        #: glock serializes stamp+enqueue against bump+drain so a send
+        #: concurrent with a reset is either wholly before it (drained) or
+        #: wholly after (stamped fresh, survives)
+        self.gen = 0
+        self.glock = threading.Lock()
         self.thread = threading.Thread(
             target=self._run, name=f"tx-{transport.node_id}->{dest}", daemon=True
         )
@@ -120,12 +128,18 @@ class _Peer:
         backoff = 0.05
         while not self.t.closed:
             try:
-                kind, payload = self.q.get(timeout=0.25)
+                gen, kind, payload = self.q.get(timeout=0.25)
             except queue.Empty:
                 continue
             # retry the same frame across reconnects until sent or give up
             attempts = 0
             while not self.t.closed:
+                if self.gen != gen:
+                    # peer was reset while this frame was in hand: a frame
+                    # queued before the reset must never reach a peer that
+                    # reconnected after it
+                    self.t._count("reset_drops")
+                    break
                 if self.sock is None:
                     self.sock = self._connect()
                     if self.sock is None:
@@ -136,6 +150,12 @@ class _Peer:
                         time.sleep(min(backoff * (2 ** attempts), 2.0))
                         continue
                     backoff = 0.05
+                if self.gen != gen:
+                    # reset landed while _connect was blocking: the new
+                    # socket may already be the peer's NEXT incarnation,
+                    # which must not see this pre-reset frame
+                    self.t._count("reset_drops")
+                    break
                 try:
                     _send_frame(self.sock, kind, payload)
                     self.t._count("sent")
@@ -148,9 +168,10 @@ class _Peer:
                     self.sock = None  # reconnect and retry this frame
 
     def close(self) -> None:
-        if self.sock is not None:
+        s = self.sock  # snapshot: the writer nulls this field concurrently
+        if s is not None:
             try:
-                self.sock.close()
+                s.close()
             except OSError:
                 pass
 
@@ -239,7 +260,8 @@ class Transport:
             if peer is None:
                 peer = self._peers[dest] = _Peer(self, dest)
         try:
-            peer.q.put_nowait((kind, payload))
+            with peer.glock:
+                peer.q.put_nowait((peer.gen, kind, payload))
         except queue.Full:
             # backpressure: drop-newest, callers with liveness needs retry via
             # protocol tasks (congestion handling, PaxosManager.java:920-935)
@@ -306,6 +328,33 @@ class Transport:
     def _count(self, key: str, n: int = 1) -> None:
         with self._slock:
             self.stats[key] = self.stats.get(key, 0) + n
+
+    def reset_peer(self, dest: str) -> None:
+        """Discard everything queued — or held by the writer mid-retry — for
+        ``dest`` and drop its connection.  The analog of the reference
+        clearing a failed node's pending writes after connect retries are
+        exhausted (``nio/NIOTransport.java:65-114`` pendingWrites/
+        pendingConnects): once a peer is declared gone, its backlog must not
+        be delivered to a later incarnation like a mailbox.  New sends after
+        this call flow normally."""
+        with self._plock:
+            peer = self._peers.get(dest)
+        if peer is None:
+            return
+        with peer.glock:
+            # bump + drain atomically vs send_raw's stamp+enqueue: nothing
+            # fresh can interleave, so everything drained here is stale
+            peer.gen += 1  # also strands the writer's in-hand frame
+            while True:
+                try:
+                    peer.q.get_nowait()
+                except queue.Empty:
+                    break
+                self._count("reset_drops")
+        # close the socket only (never null peer.sock from this thread — the
+        # writer owns that field): a concurrent sendall gets OSError, which
+        # the writer's retry path already handles
+        peer.close()
 
     def close(self) -> None:
         self.closed = True

@@ -98,37 +98,13 @@ class Cluster:
     def drop_backlog(self, nid):
         """Discard frames the survivors queued for a dead peer (emulates a
         long outage where the transport exhausted its retries — without
-        this, reconnect delivers the whole backlog like a mailbox)."""
-        import queue as _q
-
-        def drain():
-            for other in self.nodes.values():
-                peer = other.m.transport._peers.get(nid)
-                if peer is None:
-                    continue
-                while True:
-                    try:
-                        peer.q.get_nowait()
-                    except _q.Empty:
-                        break
-
-        drain()
-        # a frame already popped by the writer thread retries connecting for
-        # up to ~3.2s before being dropped; wait it out so NOTHING from the
-        # backlog survives.  Under CPU contention the retry backoff can run
-        # longer, so keep draining until the queues stay empty for a while.
-        quiet = 0
-        for _ in range(12):
-            time.sleep(1.0)
-            before = sum(
-                other.m.transport._peers[nid].q.qsize()
-                for other in self.nodes.values()
-                if nid in other.m.transport._peers
-            )
-            drain()
-            quiet = quiet + 1 if before == 0 else 0
-            if quiet >= 2 and _ >= 4:
-                break
+        this, reconnect delivers the whole backlog like a mailbox).
+        Transport.reset_peer also strands the frame a writer thread may be
+        holding mid-reconnect-retry, which a queue drain cannot see — one
+        such survivor delivered after restart() can tile a laggard's gap
+        and mask the mechanism under test."""
+        for other in self.nodes.values():
+            other.m.transport.reset_peer(nid)
 
     def restart(self, nid):
         """Rebuild the node from its own WAL and rejoin."""
@@ -259,14 +235,20 @@ def test_deep_laggard_checkpoint_transfer(tmp_path):
         # wall-clock bounded: the checkpoint request/response rides real
         # messenger threads that can lag far behind a tight tick loop on a
         # starved 1-core CI box
+        # wait for BOTH the state and the mechanism counter: the transfer
+        # apply runs on a transport reader thread and fills the app db
+        # (restore) several JAX dispatches BEFORE it bumps ckpt_transfers —
+        # polling the db alone races that window and reads the counter as 0
         deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             cl.ticks(1)
-            if cl.apps["N2"].db.get("svc", {}).get("k9") == "9":
+            if (cl.apps["N2"].db.get("svc", {}).get("k9") == "9"
+                    and cl.nodes["N2"].stats.get("ckpt_transfers", 0) >= 1):
                 break
             time.sleep(0.01)
         assert cl.apps["N2"].db["svc"]["k9"] == "9"
-        assert cl.nodes["N2"].stats["ckpt_transfers"] >= 1
+        assert cl.nodes["N2"].stats["ckpt_transfers"] >= 1, \
+            dict(cl.nodes["N2"].stats)
         # and the transfer is durable: crash N2 again right after, recover
         cl.kill("N2")
         n2 = cl.restart("N2")

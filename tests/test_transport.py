@@ -132,6 +132,49 @@ def test_reconnect_after_peer_restart():
         a.close()
 
 
+def test_reset_peer_strands_backlog_and_in_hand_frame():
+    """A dead peer's backlog — including the frame the writer thread holds
+    through its reconnect-retry window, which no queue drain can reach —
+    must not be delivered to a later incarnation after reset_peer; new
+    sends afterwards flow normally (pendingWrites cleanup on node failure,
+    ``nio/NIOTransport.java:65-114``)."""
+    nm, a, b = make_pair()
+    try:
+        sink = Sink()
+        b.register("m", sink)
+        a.send("B", {"type": "m", "i": 0})
+        assert sink.wait_for(1)
+        b.close()
+        time.sleep(0.1)
+        # drop A's established-but-dead socket so the next send is forced
+        # into the connect path (writing into the dead TCP buffer can
+        # otherwise "succeed" locally and vacate the writer's hand)
+        a.transport.reset_peer("B")
+        # the writer pops i=1 and sits in connect-retry (~3s) holding it;
+        # i=2/i=3 stay in the queue
+        for i in (1, 2, 3):
+            a.send("B", {"type": "m", "i": i})
+        time.sleep(0.3)
+        a.transport.reset_peer("B")
+        # restart B on a fresh port well inside the old retry window: the
+        # stranded frame would be delivered here if reset didn't stamp it
+        b2 = Messenger("B", ("127.0.0.1", 0), nm)
+        nm.add("B", "127.0.0.1", b2.port)
+        sink2 = Sink()
+        b2.register("m", sink2)
+        deadline = time.monotonic() + 3.5  # outlasts the retry/backoff span
+        while time.monotonic() < deadline:
+            assert not sink2.got, f"stale frame delivered: {sink2.got}"
+            time.sleep(0.1)
+        assert a.transport.stats.get("reset_drops", 0) >= 1
+        a.send("B", {"type": "m", "i": 4})
+        assert sink2.wait_for(1)
+        assert [p["i"] for _s, p in sink2.got] == [4]
+        b2.close()
+    finally:
+        a.close()
+
+
 def test_unknown_type_goes_to_default_handler():
     nm, a, b = make_pair()
     try:

@@ -180,11 +180,19 @@ class ModeBReplicaCoordinator(AbstractReplicaCoordinator):
         its own app copy.  Executing the stop implies executing everything
         before it (in-order phase 4), so a locally-stopped, untainted row IS
         the epoch-final state; otherwise return None and the fetch task
-        round-robins to another previous active (WaitEpochFinalState)."""
+        round-robins to another previous active (WaitEpochFinalState).
+
+        Atomic against :meth:`drop_final_state` (node lock): without it, a
+        drop interleaving between the stopped-check and the checkpoint can
+        free the app table first, making this donor serve found=True with
+        EMPTY state — the asker then births the new epoch empty+untainted
+        and silently diverges (the reference's null-checkpoint
+        disambiguation hazard, PaxosManager.java:383-390)."""
         pname = self._pax_name(name, epoch)
-        if not self.node.is_stopped(pname) or self.node.is_tainted(pname):
-            return None
-        return self.node.app.checkpoint(pname)
+        with self.node.lock:
+            if not self.node.is_stopped(pname) or self.node.is_tainted(pname):
+                return None
+            return self.node.app.checkpoint(pname)
 
     def final_state_gone(self, name: str, epoch: int) -> bool:
         """True when this node can say the epoch's final state is GONE for
@@ -204,12 +212,20 @@ class ModeBReplicaCoordinator(AbstractReplicaCoordinator):
 
     def drop_final_state(self, name: str, epoch: int) -> bool:
         pname = self._pax_name(name, epoch)
-        self.node.app.restore(pname, b"")  # free app state
-        if self._epoch.get(name) == epoch:
-            del self._epoch[name]
-        if self.node.rows.row(pname) is None:
-            return True
-        return self.node.remove_group(pname)
+        with self.node.lock:  # atomic vs get_final_state (see its docstring)
+            if self._epoch.get(name) == epoch:
+                del self._epoch[name]
+            # remove the row BEFORE freeing app state: a donor query after
+            # this block sees no row -> None (+ final_state_gone=True, the
+            # safe tainted-birth path), never a freed app's empty
+            # checkpoint.  A PAUSED (spilled) group counts as present — its
+            # _paused record would otherwise keep answering is_stopped
+            # forever while the app table below is freed
+            present = (self.node.rows.row(pname) is not None
+                       or pname in self.node._paused)
+            ok = self.node.remove_group(pname) if present else True
+            self.node.app.restore(pname, b"")  # free app state
+            return ok
 
 
 class ModeBRepliconfigurableDB:

@@ -290,35 +290,51 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
         return rid is not None
 
     def get_final_state(self, name: str, epoch: int) -> Optional[bytes]:
+        # Held under the manager lock to be atomic against
+        # drop_final_state: a drop interleaving between the stopped-check
+        # and the checkpoint would free the app table first and make this
+        # donor serve found=True with EMPTY state — the asker then births
+        # the new epoch empty+untainted and silently diverges (the
+        # null-checkpoint disambiguation hazard, PaxosManager.java:383-390)
         pname = self._pax_name(name, epoch)
-        if not self.manager.is_stopped(pname):
+        with self.manager.lock:
+            if not self.manager.is_stopped(pname):
+                return None
+            members = self.manager.group_members(pname)
+            if not members:
+                return None
+            # The donor must be a member at the group's maximum execution
+            # watermark: a just-revived laggard is alive but holds pre-stop
+            # state, and checkpointing it would seed the next epoch with
+            # lost writes.  If only dead members hold the final state,
+            # return None and let the fetch task retry
+            # (WaitEpochFinalState).
+            marks = self.manager.exec_watermarks(pname)
+            if marks is None:
+                return None
+            final = max(marks[s] for s in members)
+            for s in members:
+                if self.manager.alive[s] and marks[s] == final:
+                    return self.manager.apps[s].checkpoint(pname)
             return None
-        members = self.manager.group_members(pname)
-        if not members:
-            return None
-        # The donor must be a member at the group's maximum execution
-        # watermark: a just-revived laggard is alive but holds pre-stop
-        # state, and checkpointing it would seed the next epoch with lost
-        # writes.  If only dead members hold the final state, return None
-        # and let the fetch task retry (WaitEpochFinalState).
-        marks = self.manager.exec_watermarks(pname)
-        if marks is None:
-            return None
-        final = max(marks[s] for s in members)
-        for s in members:
-            if self.manager.alive[s] and marks[s] == final:
-                return self.manager.apps[s].checkpoint(pname)
-        return None
 
     def drop_final_state(self, name: str, epoch: int) -> bool:
         pname = self._pax_name(name, epoch)
-        members = self.manager.group_members(pname) or []
-        for s in members:
-            self.manager.apps[s].restore(pname, b"")  # free app state
-        # dropping the live epoch (name deletion) must clear the epoch map,
-        # or a later re-creation at epoch 0 looks like a duplicate StartEpoch
-        if self._epoch.get(name) == epoch:
-            del self._epoch[name]
-        if self.manager.rows.row(pname) is None:
-            return True
-        return self.manager.remove_paxos_instance(pname)
+        with self.manager.lock:  # atomic vs get_final_state (see above)
+            members = self.manager.group_members(pname) or []
+            # dropping the live epoch (name deletion) must clear the epoch
+            # map, or a later re-creation at epoch 0 looks like a duplicate
+            # StartEpoch
+            if self._epoch.get(name) == epoch:
+                del self._epoch[name]
+            # remove the row BEFORE freeing app state: a donor query after
+            # this block sees no row -> None (the safe retry/tainted-birth
+            # path), never a freed app's empty checkpoint.  A PAUSED
+            # (spilled) group counts as present — its _paused record would
+            # otherwise keep answering is_stopped/exec_watermarks forever
+            present = (self.manager.rows.row(pname) is not None
+                       or pname in self.manager._paused)
+            ok = self.manager.remove_paxos_instance(pname) if present else True
+            for s in members:
+                self.manager.apps[s].restore(pname, b"")  # free app state
+            return ok

@@ -128,6 +128,67 @@ def test_coordinator_create_request_epoch_bump_and_final_state():
     assert coord.get_final_state("svc", 0) is None
 
 
+def test_final_state_never_served_empty_during_drop():
+    """get_final_state racing drop_final_state must return the real final
+    state or None — never found-with-EMPTY-bytes.  A drop that frees the
+    app table before the row (or without excluding donors) lets a donor
+    answer found=True/state=b'' and the fetching newcomer births the new
+    epoch empty+UNTAINTED — silent divergence (the null-checkpoint
+    disambiguation hazard, PaxosManager.java:383-390).  Same invariant
+    holds for the Mode B coordinator (modeb/coordinator.py)."""
+    import threading as _t
+    import time as _time
+
+    coord, mgr, nodes = make_coord()
+    coord.create_replica_group("svc", 0, b"", nodes)
+    got = []
+    coord.coordinate_request("svc", 0, b"PUT k v0",
+                             lambda r, resp: got.append(resp))
+    mgr.run_ticks(4)
+    assert got == [b"OK"]
+    done = []
+    coord.stop_replica_group("svc", 0, lambda ok: done.append(ok))
+    mgr.run_ticks(4)
+    assert done == [True]
+    real = coord.get_final_state("svc", 0)
+    assert real and b"v0" in real
+
+    # widen the drop's app-free window so an unserialized reader would
+    # reliably land inside it
+    slow_restores = []
+    for app in mgr.apps:
+        orig = app.restore
+
+        def slow(name, state, _o=orig):
+            _time.sleep(0.05)
+            _o(name, state)
+        slow_restores.append((app, orig))
+        app.restore = slow
+
+    seen = []
+    stop_flag = []
+
+    def reader():
+        while not stop_flag:
+            seen.append(coord.get_final_state("svc", 0))
+            _time.sleep(0.001)
+
+    th = _t.Thread(target=reader, daemon=True)
+    th.start()
+    try:
+        _time.sleep(0.02)
+        assert coord.drop_final_state("svc", 0)
+        _time.sleep(0.05)
+    finally:
+        stop_flag.append(True)
+        th.join(timeout=10)
+        for app, orig in slow_restores:
+            app.restore = orig
+    assert all(s is None or (s and b"v0" in s) for s in seen), \
+        [s for s in seen if not (s is None or (s and b"v0" in s))]
+    assert coord.get_final_state("svc", 0) is None
+
+
 def test_coordinator_final_state_not_available_before_stop():
     coord, mgr, nodes = make_coord()
     coord.create_replica_group("svc", 0, b"", nodes)

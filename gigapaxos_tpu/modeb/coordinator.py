@@ -204,8 +204,8 @@ class ModeBReplicaCoordinator(AbstractReplicaCoordinator):
         with self.node.lock:
             if self.node.rows.row(pname) is not None:
                 return False  # still hosted (stopped or not): transient
-            if pname in self.node._paused:
-                return False
+            if pname in getattr(self.node, "_paused", ()):
+                return False  # spilled (ChainModeBNode has no pause tier)
             live = self._epoch.get(name, -1)
             # hosted later epoch, or dropped our last epoch entirely
             return live > epoch or live == -1
@@ -221,8 +221,10 @@ class ModeBReplicaCoordinator(AbstractReplicaCoordinator):
             # checkpoint.  A PAUSED (spilled) group counts as present — its
             # _paused record would otherwise keep answering is_stopped
             # forever while the app table below is freed
+            # getattr: this binding also runs over ChainModeBNode
+            # (server.py coordinator == "chain"), which has no pause tier
             present = (self.node.rows.row(pname) is not None
-                       or pname in self.node._paused)
+                       or pname in getattr(self.node, "_paused", ()))
             ok = self.node.remove_group(pname) if present else True
             self.node.app.restore(pname, b"")  # free app state
             return ok

@@ -332,8 +332,10 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
             # path), never a freed app's empty checkpoint.  A PAUSED
             # (spilled) group counts as present — its _paused record would
             # otherwise keep answering is_stopped/exec_watermarks forever
+            # getattr: ChainManager shares this binding but has no pause
+            # tier (chain/coordinator.py duck-types the manager surface)
             present = (self.manager.rows.row(pname) is not None
-                       or pname in self.manager._paused)
+                       or pname in getattr(self.manager, "_paused", ()))
             ok = self.manager.remove_paxos_instance(pname) if present else True
             for s in members:
                 self.manager.apps[s].restore(pname, b"")  # free app state

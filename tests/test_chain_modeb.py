@@ -326,3 +326,22 @@ def test_chain_expand_universe_and_commit_through_new_tail():
         assert cl.commit("C2", "old", b"PUT b 2") == b"OK"
     finally:
         cl.close()
+
+
+def test_chain_node_epoch_gc_duck_typing(cluster):
+    """ModeBReplicaCoordinator duck-types over ChainModeBNode (server.py
+    coordinator == 'chain'), which has no pause tier: the epoch-GC donor
+    paths (drop_final_state retransmits for an already-dropped epoch,
+    final_state_gone probes) must not assume `_paused` exists."""
+    from gigapaxos_tpu.modeb.coordinator import ModeBReplicaCoordinator
+
+    node = cluster.nodes["C0"]
+    coord = ModeBReplicaCoordinator(node)
+    assert coord.create_replica_group("csvc", 0, b"", list(IDS))
+    # routine WaitAckDropEpoch retransmit for an epoch never hosted here
+    assert coord.drop_final_state("csvc", -1)
+    assert coord.get_final_state("csvc", -1) is None
+    assert coord.final_state_gone("csvc", -1)
+    # and for one that exists: drop removes the row before freeing state
+    assert coord.drop_final_state("csvc", 0)
+    assert coord.get_final_state("csvc", 0) is None

@@ -189,6 +189,40 @@ def test_final_state_never_served_empty_during_drop():
     assert coord.get_final_state("svc", 0) is None
 
 
+def test_drop_final_state_clears_paused_stopped_epoch():
+    """A stopped previous-epoch group that got PAUSED (spilled) under row
+    pressure must still be fully removed by drop_final_state: leaving the
+    _paused record behind would keep is_stopped/exec_watermarks answering
+    from it while the app table below was freed — a donor serving
+    found=True with EMPTY state (the paused variant of the drop race)."""
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 8
+    cfg.paxos.deactivation_ticks = 0  # everything quiescent is pausable
+    mgr = PaxosManager(cfg, 3, [KVApp() for _ in range(3)])
+    nodes = [f"AR{i}" for i in range(3)]
+    coord = PaxosReplicaCoordinator(mgr, nodes)
+    assert coord.create_replica_group("svc", 0, b"", nodes)
+    got = []
+    coord.coordinate_request("svc", 0, b"PUT k v0",
+                             lambda r, resp: got.append(resp))
+    mgr.run_ticks(4)
+    assert got == [b"OK"]
+    done = []
+    coord.stop_replica_group("svc", 0, lambda ok: done.append(ok))
+    mgr.run_ticks(4)
+    assert done == [True]
+    assert mgr.pause_idle(limit=8) >= 1
+    assert mgr.rows.row("svc#0") is None and mgr.paused_count() >= 1
+    # the donor still serves the REAL final state from the spill
+    fs = coord.get_final_state("svc", 0)
+    assert fs is not None and b"v0" in fs
+    # GC: the paused record must go with the drop
+    assert coord.drop_final_state("svc", 0)
+    assert mgr.paused_count() == 0
+    assert coord.get_final_state("svc", 0) is None
+    assert not mgr.is_stopped("svc#0")
+
+
 def test_coordinator_final_state_not_available_before_stop():
     coord, mgr, nodes = make_coord()
     coord.create_replica_group("svc", 0, b"", nodes)

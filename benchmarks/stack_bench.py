@@ -171,7 +171,11 @@ def main() -> None:
         "detail": {
             "ticks_per_s": round(args.ticks / dt, 2),
             "completions_per_s": round(done / dt, 1),
-            "executions_per_s": round(decisions * R / dt, 1),
+            # unreplicated executes at the entry replica ONLY (no
+            # coordination); every other mode executes on all R replicas
+            "executions_per_s": round(
+                decisions * (1 if args.baseline == "unreplicated" else R)
+                / dt, 1),
             "groups": G,
             "create_s": round(create_s, 2),
             "wal": bool(args.wal),

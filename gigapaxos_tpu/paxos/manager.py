@@ -155,6 +155,9 @@ class PaxosManager:
         self._bulk_leftover = np.zeros(0, np.int64)  # queued, not yet placed
         self._bulk_placed = None  # (rids, entries, ps, rows) of last tick
         self._lag_pending = (np.zeros(0, np.int64), np.zeros(0, np.int64))
+        #: (replica, row) transfers noticed during tick completion, run at
+        #: the next tick() top after a pipeline drain (watermark/blob skew)
+        self._lag_sync_due: list = []
         # ---- device-resident application (models/device_kv.py) ----
         self._device_app = bool(cfg.paxos.device_app)
         self.kv = None
@@ -1176,11 +1179,48 @@ class PaxosManager:
         self._bulk_leftover = (np.concatenate(parts) if len(parts) > 1
                                else (parts[0] if parts else rest))
 
+    def _run_due_laggard_syncs(self) -> None:
+        """Run checkpoint transfers noticed during tick completion.
+
+        Runs at the top of tick(), after draining the pipeline: the
+        transfer must capture the donor's device exec watermark and host
+        app state at the SAME point — inside completion the device is one
+        pipelined tick ahead of the host apps, and a laggard adopting that
+        skewed pair permanently skips the slots between them (found live:
+        a released write missing on every sync-repaired replica)."""
+        due, self._lag_sync_due = self._lag_sync_due, []
+        if not due:
+            return
+        # re-check lag against CURRENT state first: pipelined completion
+        # re-enqueues from the pre-repair outbox, and paying a pipeline
+        # drain just to have every sync refuse (donor not ahead) would
+        # stall the device/host overlap on the tick after every repair
+        exec_slot = np.asarray(self.state.exec_slot)
+        still, seen = [], set()
+        for r_, row_ in due:
+            key = (int(r_), int(row_))
+            if key in seen or not self.alive[key[0]]:
+                continue
+            seen.add(key)
+            ms = self._member_np[:, key[1]]
+            if not ms[key[0]]:
+                continue
+            if exec_slot[ms, key[1]].max() - exec_slot[key] >= self.W:
+                still.append(key)
+        if not still:
+            return
+        self.drain_pipeline()  # host apps catch up to the device watermark
+        for r_, row_ in still:
+            name = self.rows.name(row_)
+            if name:
+                self.sync_laggard(r_, name)
+
     @_locked
     def tick(self):
         """One manager step.  Returns the tick's :class:`HostOutbox` (full
         mode) / :class:`CompactHostOutbox` (compact mode); in pipelined mode
         the return is the PREVIOUS tick's outbox (None on the first)."""
+        self._run_due_laggard_syncs()
         if self._device_app:
             # descriptor upload rides the same fused program as the tick;
             # watermark must advance BEFORE the build so those rids place
@@ -1315,6 +1355,19 @@ class PaxosManager:
                         is_stop = bool(es[r, j, row])
                         self._execute_one(r, int(row), name, rid, slot, is_stop)
         self.stats["decisions"] += int(out.decided_now.sum())
+        # Self-heal laggards in FULL-outbox mode too (the compact path has
+        # the twin block in _process_compact): a replica >= W behind can
+        # never catch up by ring sync — its missed slots rotated out of
+        # every decision ring — and in a quiescent system no later tick
+        # will surface the lag through new decisions, so the stall is
+        # permanent without this.  During journal replay repairs must come
+        # only from journaled OP_SYNC records (see _process_compact).
+        # Deferred to tick() for watermark/blob consistency (see
+        # _run_due_laggard_syncs).
+        if (self.cfg.paxos.auto_laggard_sync
+                and getattr(self, "_replay_process", None) is None):
+            lag = np.asarray(out.lag)
+            self._lag_sync_due.extend(zip(*np.where(lag >= self.W)))
 
     def _execute_one(self, r: int, row: int, name: str, rid: int, slot: int,
                      is_stop: bool) -> None:
@@ -1536,34 +1589,46 @@ class PaxosManager:
             # self-heal: a replica >= W behind can never catch up by ring
             # sync — its missed slots have rotated out of every decision
             # ring.  The budget's fair ordering prevents self-inflicted
-            # lag, but crashes/recoveries still produce it.
-            for r_, row_ in zip(*self._lag_pending):
-                if not self.alive[r_]:
-                    continue
-                name = self.rows.name(int(row_))
-                if name:
-                    self.sync_laggard(int(r_), name)
+            # lag, but crashes/recoveries still produce it.  DEFERRED to
+            # tick() (see _run_due_laggard_syncs): a transfer captured
+            # inside completion pairs the donor's device watermark with a
+            # host app state one pipelined tick behind it, and the laggard
+            # would permanently skip the difference.
+            self._lag_sync_due.extend(zip(*self._lag_pending))
 
     def _sweep_outstanding(self) -> None:
-        """Drop responded records whose slot every live member has passed
-        (laggards that far behind catch up by checkpoint transfer, not
-        replay, so the payload is no longer needed)."""
+        """Drop responded records whose payload can never be needed again:
+        every member has executed past the slot, OR the slot has rotated
+        out of every decision ring (slot <= base - W), in which case any
+        replica still behind it can only catch up by checkpoint transfer,
+        which carries the state, not the payload.
+
+        A slot still inside the ring window of a DEAD member's gap must
+        keep its payload: when that member revives with gap < W it
+        catches up by ring REPLAY, and executing a swept slot would
+        silently skip it (found live: a released write missing on the
+        revived replica, then spread to others by checkpoint donation)."""
         if not self.outstanding and (self.bulk is None
                                      or self.bulk.n_live == 0):
             return
         exec_slot = np.array(self.state.exec_slot)
         if self.bulk is not None and self.bulk.n_live:
-            # vectorized twin for the store: free responded requests whose
-            # slot every LIVE member passed (a dead member's executed-bit
-            # will never arrive; its catch-up is a checkpoint transfer)
+            # vectorized twin for the store
             s = self.bulk
-            live_exec = np.where(self._member_np & self.alive[:, None],
-                                 exec_slot, np.iinfo(np.int32).max)
-            lmin = live_exec.min(axis=0)  # [G] min live-member watermark
+            member_exec = np.where(self._member_np, exec_slot,
+                                   np.iinfo(np.int32).max)
+            amin = member_exec.min(axis=0)  # [G] min ALL-member watermark
+            base = np.where(self._member_np, exec_slot,
+                            np.iinfo(np.int32).min).max(axis=0)  # [G]
             any_live = (self._member_np & self.alive[:, None]).any(axis=0)
+            # rotation bound is STRICT: executed-through base-1 only proves
+            # decisions through base-1, and slot s's ring plane survives
+            # until s+W is decided — so s == base-W can still ride the
+            # ring to a revived replica and must keep its payload
             sel = np.nonzero(
-                s.valid & s.responded & (s.slot >= 0)
-                & any_live[s.row] & (s.slot < lmin[s.row])
+                s.valid & s.responded & (s.slot >= 0) & any_live[s.row]
+                & ((s.slot < amin[s.row])
+                   | (s.slot < base[s.row] - self.W))
             )[0]
             if len(sel):
                 s.valid[sel] = False
@@ -1580,8 +1645,11 @@ class PaxosManager:
             if not rec.responded or rec.slot < 0:
                 continue
             ms = np.where(member[:, rec.row])[0]
-            live = [m for m in ms if self.alive[m]]
-            if live and all(exec_slot[m, rec.row] > rec.slot for m in live):
+            if not any(self.alive[m] for m in ms):
+                continue
+            marks = [int(exec_slot[m, rec.row]) for m in ms]
+            if (all(mk > rec.slot for mk in marks)
+                    or rec.slot < max(marks) - self.W):  # strict: see above
                 dead.append(rid)
         for rid in dead:
             self._row_outstanding[self.outstanding[rid].row] -= 1
@@ -1600,10 +1668,17 @@ class PaxosManager:
         the most advanced live member and restore its app state.
 
         The transfer mutates device state outside the journaled tick
-        stream, so it is journaled itself (OP_SYNC with the chosen donor);
-        replay passes ``donor`` explicitly because the liveness view that
-        picked it is not part of the journal.
+        stream, so it is journaled itself — as the EXACT transferred values
+        (donor exec watermark, status, checkpoint blob), not just the donor
+        id: under pipelined ticks the sync lands one tick behind the
+        OP_TICK record appended at dispatch, so re-deriving the transfer
+        from the donor's replay-time state would adopt a skewed watermark
+        and the divergence compounds through every later replayed tick.
         """
+        # the captured (watermark, blob) pair must be consistent: with a
+        # pipelined tick in flight the device watermark is ahead of the
+        # host apps by that tick's executions
+        self.drain_pipeline()
         row = self.rows.row(name)
         if row is None:
             return False
@@ -1616,15 +1691,38 @@ class PaxosManager:
             donor = max(donors, key=lambda m: exec_slot[m])
         if exec_slot[donor] <= exec_slot[r]:
             return False
-        if self.wal is not None:
-            self.wal.log_sync(r, name, int(donor))
         ckpt = self.apps[donor].checkpoint(name)
+        donor_exec = int(exec_slot[donor])
+        donor_status = int(self.state.status[donor, row])
+        if self.wal is not None:
+            self.wal.log_sync(r, name, int(donor), donor_exec, donor_status,
+                              ckpt)
+        self._apply_sync_values(r, int(row), name, donor_exec, donor_status,
+                                ckpt)
+        self.stats["checkpoint_transfers"] += 1
+        return True
+
+    @_locked
+    def apply_sync(self, r: int, name: str, donor_exec: int,
+                   donor_status: int, ckpt: bytes) -> bool:
+        """Journal-replay entry: re-apply a checkpoint transfer verbatim
+        from its OP_SYNC record (no donor-state re-derivation)."""
+        row = self.rows.row(name)
+        if row is None:
+            return False
+        self._apply_sync_values(r, int(row), name, donor_exec, donor_status,
+                                ckpt)
+        self.stats["checkpoint_transfers"] += 1
+        return True
+
+    def _apply_sync_values(self, r: int, row: int, name: str,
+                           donor_exec: int, donor_status: int,
+                           ckpt: bytes) -> None:
+        old_exec = int(np.asarray(self.state.exec_slot[r, row]))
         self.apps[r].restore(name, ckpt)
         self.state = self.state._replace(
-            exec_slot=self.state.exec_slot.at[r, row].set(int(exec_slot[donor])),
-            status=self.state.status.at[r, row].set(
-                int(self.state.status[donor, row])
-            ),
+            exec_slot=self.state.exec_slot.at[r, row].set(donor_exec),
+            status=self.state.status.at[r, row].set(donor_status),
         )
         self._seen.pop((r, row), None)
         # a transfer skips slots [old, donor) on r without ever reporting
@@ -1633,7 +1731,7 @@ class PaxosManager:
         # marked responded with no payload (client retries; at-least-once).
         if self.bulk is not None:
             s = self.bulk
-            lo, hi = int(exec_slot[r]), int(exec_slot[donor])
+            lo, hi = old_exec, donor_exec
             sel = np.nonzero(
                 s.valid & (s.row == row) & (s.slot >= lo) & (s.slot < hi)
             )[0]
@@ -1644,8 +1742,6 @@ class PaxosManager:
                 if (self._bulk_cbs or self._sink_blocks) and ent.any():
                     self._bulk_fire(s.rid[sel[ent]])  # duty skipped: None
                 s.free_done(sel, self._member_bits[s.row[sel]])
-        self.stats["checkpoint_transfers"] += 1
-        return True
 
     @_locked
     def auto_sync_laggards(self, out=None) -> int:

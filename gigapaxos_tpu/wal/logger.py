@@ -118,8 +118,16 @@ class PaxosLogger:
     def log_unpause(self, name: str) -> None:
         self.journal.append(records.dumps((OP_UNPAUSE, name)))
 
-    def log_sync(self, r: int, name: str, donor: int) -> None:
-        self.journal.append(records.dumps((OP_SYNC, r, name, donor)))
+    def log_sync(self, r: int, name: str, donor: int, donor_exec: int,
+                 donor_status: int, ckpt: bytes) -> None:
+        """The record carries the EXACT transferred values, not just the
+        donor id: under pipelined ticks the sync is applied one tick after
+        the OP_TICK appended at dispatch, so replay re-deriving the
+        transfer from the donor's replay-time state would adopt a skewed
+        watermark and diverge from the crash run."""
+        self.journal.append(records.dumps(
+            (OP_SYNC, r, name, donor, donor_exec, donor_status, ckpt)
+        ))
 
     def log_inbox(self, tick_num: int, inbox) -> None:
         """Called by the manager after `_build_inbox`, before running the
@@ -332,8 +340,12 @@ def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
             elif op == OP_UNPAUSE:
                 m._unpause(rec[1])
             elif op == OP_SYNC:
-                _, r, name, donor = rec
-                m.sync_laggard(r, name, donor=donor)
+                if len(rec) >= 7:  # exact record: apply verbatim
+                    _, r, name, _donor, d_exec, d_status, ckpt = rec[:7]
+                    m.apply_sync(r, name, d_exec, d_status, ckpt)
+                else:  # legacy donor-only record (pre-round-5 journals)
+                    _, r, name, donor = rec
+                    m.sync_laggard(r, name, donor=donor)
             elif op == OP_TICK:
                 _, tick_num, placed, alive_b = rec[:4]
                 bulk_rec = rec[4] if len(rec) > 4 else None
@@ -375,6 +387,12 @@ def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
                 else:
                     m._process_outbox(out)
                 m.tick_num = tick_num + 1
+    # laggard repairs during replay come ONLY from OP_SYNC records, but the
+    # replayed completions still queued the lag they observed — discard it,
+    # or the first live tick bursts through a journal's worth of stale
+    # (mostly already-repaired) transfer attempts
+    if hasattr(m, "_lag_sync_due"):
+        m._lag_sync_due.clear()
 
 
 def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,

@@ -77,6 +77,10 @@ def test_failover_mid_stream_no_loss():
 def test_checkpoint_transfer_beyond_window():
     apps = [KVApp() for _ in range(3)]
     m = mk_manager(apps=apps, window=8)
+    # exercise the MANUAL repair API: the automatic in-tick repair (see
+    # test_quiescent_laggard_auto_repair_full_outbox) would beat it to the
+    # transfer and leave it nothing to do
+    m.cfg.paxos.auto_laggard_sync = False
     m.create_paxos_instance("svc", [0, 1, 2])
     m.set_alive(2, False)
     for i in range(30):  # 30 > W while replica 2 is down
@@ -251,3 +255,37 @@ def test_bulk_create_wal_replay(tmp_path):
     assert {n: m2.rows.row(n) for n in rows_live} == rows_live
     for r in range(3):
         assert m2.apps[r].db["w3"]["k"] in (b"v3", "v3")
+
+
+def test_quiescent_laggard_auto_repair_full_outbox():
+    """A replica that misses more than W decisions while dead must be
+    repaired by checkpoint transfer even if NO new load ever arrives: its
+    missed slots rotated out of every decision ring, and in a quiescent
+    system no later decision surfaces the lag — without the repair in the
+    default (full-outbox) path the stall is permanent.  Caught live by a
+    randomized soak: replica 0 stuck 61 slots behind through 56 all-alive
+    ticks (StatePacket/handleCheckpoint analog,
+    PaxosInstanceStateMachine.java:1852-1861)."""
+    apps = [KVApp() for _ in range(3)]
+    m = mk_manager(apps=apps, window=8)
+    m.create_paxos_instance("svc", [0, 1, 2])
+    got = []
+    m.propose("svc", b"PUT seed 0", lambda r, v: got.append(v))
+    m.run_ticks(4)
+    assert got == [b"OK"]
+    m.set_alive(0, False)
+    done = []
+    for i in range(12):  # 12 > W=8: beyond any ring's reach
+        m.propose("svc", f"PUT k{i} {i}".encode(),
+                  lambda r, v: done.append(v))
+    m.run_ticks(20)
+    assert done == [b"OK"] * 12
+    assert int(m.exec_watermarks("svc")[0]) < int(m.exec_watermarks("svc")[2])
+    # replica 0 returns; the system stays COMPLETELY quiescent
+    m.set_alive(0, True)
+    m.run_ticks(8)
+    marks = m.exec_watermarks("svc")
+    assert int(marks[0]) == int(marks[1]) == int(marks[2]), marks.tolist()
+    for i in range(12):
+        assert apps[0].execute("svc", f"GET k{i}".encode(), 10_000 + i) \
+            == str(i).encode()

@@ -1336,6 +1336,13 @@ class ModeBNode(ModeBCommon):
     def _on_ckpt_req(self, sender: str, p: dict) -> None:
         gid = int(p["gid"])
         with self.lock:
+            # the donated (watermark, blob) pair must be consistent: with a
+            # pipelined tick in flight the device exec watermark is ahead
+            # of the app by that tick's undelivered executions, and the
+            # asker would adopt the watermark while the blob lacks them —
+            # permanently skipping those slots (the Mode A twin lost
+            # acknowledged writes this way; paxos/manager.py sync_laggard)
+            self.drain_pipeline()
             row = self._gid_row.get(gid)
             if row is None or row in self._tainted_rows:
                 return  # never donate a diverged copy

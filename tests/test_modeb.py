@@ -290,3 +290,52 @@ def test_missed_birthing_whois(cluster):
     assert "late" in cluster.nodes["N2"].rows
     cluster.ticks(40)
     assert cluster.apps["N2"].db.get("late", {}).get("x") == "9"
+
+
+def test_ckpt_donation_consistent_under_pipelined_tick(tmp_path):
+    """A checkpoint donor with a pipelined tick in flight must not pair
+    the device exec watermark with an app blob that lacks that tick's
+    undelivered executions — the asker would adopt the watermark and
+    permanently skip the difference (the Mode A twin of this skew lost
+    acknowledged writes; see paxos/manager.py sync_laggard)."""
+    import json as _json
+
+    cfg = make_cfg(window=4)
+    cfg.paxos.pipeline_ticks = True
+    nm = NodeMap()
+    m0 = Messenger("N0", ("127.0.0.1", 0), nm)
+    nm.add("N0", "127.0.0.1", m0.port)
+    app = KVApp()
+    node = ModeBNode(cfg, ["N0"], "N0", app, m0)
+    sent = []
+    node.m.send = lambda dest, pkt: sent.append((dest, pkt))
+    try:
+        node.create_group("svc", [0])
+        done = []
+        node.propose("svc", b"PUT a 1", lambda r, v: done.append(v))
+        for _ in range(12):
+            node.tick()
+            if done:
+                break
+        assert done == [b"OK"]
+        # put one more decision INTO the pipeline: tick once so the device
+        # has executed it but the host has not delivered it yet
+        node.propose("svc", b"PUT b 2", lambda r, v: done.append(v))
+        node.tick()
+        row = node.rows.row("svc")
+        import gigapaxos_tpu.modeb.wire as wire
+        node._on_ckpt_req("N9", {"gid": str(wire.gid_of("svc"))})
+        assert sent, "no checkpoint reply produced"
+        reply = sent[-1][1]
+        blob = bytes.fromhex(reply["state"])
+        db = _json.loads(blob.decode()) if blob else {}
+        wm = int(reply["exec_slot"])
+        have = int(np.asarray(node.state.exec_slot[0, row]))
+        assert wm == have, (wm, have)
+        # the blob must contain EVERYTHING the watermark claims: if the
+        # device executed 'PUT b 2' (watermark advanced), it is in the blob
+        if wm >= 3:  # create-noop + two puts
+            assert db.get("b") == "2", (wm, db)
+        assert db.get("a") == "1", (wm, db)
+    finally:
+        node.close()

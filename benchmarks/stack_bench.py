@@ -12,7 +12,7 @@ load with admission control, steady-state window measured.
 
 Usage:  python benchmarks/stack_bench.py [--groups N] [--ticks T] [--wal]
         [--platform cpu] [--profile]
-Prints one JSON line per run; commit the output into results_r4.json.
+Prints one JSON line per run; commit the output into the current round artifact (benchmarks/results_r5.json).
 """
 
 from __future__ import annotations

@@ -127,8 +127,9 @@ def test_noop_decisions_allowed():
         # (assertions inside run_random cover S1-S3)
 
 
-@pytest.mark.parametrize("seed", [7, 13, 32])
-def test_manager_random_crash_recover_pipelined(tmp_path, seed):
+@pytest.mark.parametrize("seed,compact", [(7, False), (13, False),
+                                           (32, False), (7, True)])
+def test_manager_random_crash_recover_pipelined(tmp_path, seed, compact):
     """Manager-level randomized safety with PIPELINED ticks + WAL: random
     request arrivals, random replica crash/recover (majority kept alive),
     periodic checkpoints (which drain the pipeline), then a full process
@@ -151,6 +152,9 @@ def test_manager_random_crash_recover_pipelined(tmp_path, seed):
     rng = np.random.default_rng(seed)
     cfg = GigapaxosTpuConfig()
     cfg.paxos.pipeline_ticks = True
+    if compact:  # the compact-outbox twin of every repair path
+        cfg.paxos.compact_outbox = True
+        cfg.paxos.exec_budget = 4096
     wal = PaxosLogger(os.path.join(str(tmp_path), "wal"),
                       checkpoint_every_ticks=16)
     apps = [KVApp() for _ in range(3)]

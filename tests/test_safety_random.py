@@ -154,7 +154,6 @@ def test_manager_random_crash_recover_pipelined(tmp_path, seed, compact):
     cfg.paxos.pipeline_ticks = True
     if compact:  # the compact-outbox twin of every repair path
         cfg.paxos.compact_outbox = True
-        cfg.paxos.exec_budget = 4096
     wal = PaxosLogger(os.path.join(str(tmp_path), "wal"),
                       checkpoint_every_ticks=16)
     apps = [KVApp() for _ in range(3)]

@@ -339,3 +339,57 @@ def test_ckpt_donation_consistent_under_pipelined_tick(tmp_path):
         assert db.get("a") == "1", (wm, db)
     finally:
         node.close()
+
+
+@pytest.mark.parametrize("seed", [1, 4, 9])
+def test_random_kill_restart_released_writes_converge(tmp_path, seed):
+    """Randomized Mode B durability: random commits at random nodes under
+    random single-node deaths + journal restarts (majority always alive,
+    backlogs dropped on outage) — every response RELEASED to a client must
+    converge onto every node's app.  The per-process twin of the Mode A
+    crash/recover property (tests/test_safety_random.py)."""
+    rng = np.random.default_rng(seed)
+    cl = Cluster(make_cfg(window=4), wal_root=tmp_path)
+    released = {}
+    dead = None
+    try:
+        cl.create("svc")
+        n = 0
+        for step in range(30):
+            if dead is None and rng.random() < 0.2:
+                dead = IDS[int(rng.integers(0, 3))]
+                cl.kill(dead)
+            elif dead is not None and rng.random() < 0.4:
+                cl.drop_backlog(dead)
+                cl.restart(dead)
+                dead = None
+            at = rng.choice([i for i in IDS if i != dead])
+            n += 1
+            k, v = f"k{n}", str(step)
+            # kill() removed the dead node from cl.nodes; ticks() only
+            # drives survivors, so no `only` filter is needed
+            try:
+                resp = cl.commit(str(at), "svc", f"PUT {k} {v}".encode(),
+                                 timeout_ticks=240)
+            except AssertionError:
+                continue  # not released -> no durability obligation
+            if resp == b"OK":
+                released[k] = v
+        if dead is not None:
+            cl.drop_backlog(dead)
+            cl.restart(dead)
+        deadline = time.monotonic() + 150
+        while time.monotonic() < deadline:
+            cl.ticks(1)
+            if all(cl.apps[nid].db.get("svc", {}).get(k) == v
+                   for nid in IDS for k, v in released.items()):
+                break
+            time.sleep(0.01)
+        for nid in IDS:
+            db = cl.apps[nid].db.get("svc", {})
+            missing = {k: v for k, v in released.items() if db.get(k) != v}
+            assert not missing, (nid, len(missing), dict(
+                list(missing.items())[:4]))
+        assert released  # the run must have exercised something
+    finally:
+        cl.close()

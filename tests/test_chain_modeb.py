@@ -29,8 +29,11 @@ def make_cfg(groups=16, window=8):
 
 
 class Cluster:
-    def __init__(self, cfg):
+    def __init__(self, cfg, wal_root=None):
+        from gigapaxos_tpu.chain.modeb_logger import ChainBLogger
+
         self.cfg = cfg
+        self.wal_root = wal_root
         self.nodemap = NodeMap()
         self.msgs = {}
         self.apps = {}
@@ -40,9 +43,12 @@ class Cluster:
             self.nodemap.add(nid, "127.0.0.1", m.port)
             self.msgs[nid] = m
         for nid in IDS:
+            wal = None
+            if wal_root is not None:
+                wal = ChainBLogger(str(wal_root / nid), native=False)
             self.apps[nid] = KVApp()
             self.nodes[nid] = ChainModeBNode(
-                cfg, IDS, nid, self.apps[nid], self.msgs[nid],
+                cfg, IDS, nid, self.apps[nid], self.msgs[nid], wal=wal,
                 anti_entropy_every=16,
             )
 
@@ -77,6 +83,30 @@ class Cluster:
         del self.nodes[nid]
         for n in self.nodes.values():
             n.set_alive(dead, False)
+
+    def drop_backlog(self, nid):
+        """reset_peer also strands a writer-held in-flight frame — one
+        delivered to the restarted incarnation can mask the mechanism
+        under test (see tests/test_modeb.py drop_backlog)."""
+        for other in self.nodes.values():
+            other.m.transport.reset_peer(nid)
+
+    def restart(self, nid):
+        from gigapaxos_tpu.chain.modeb_logger import recover_chain_modeb
+
+        assert self.wal_root is not None
+        self.apps[nid] = KVApp()
+        node = recover_chain_modeb(self.cfg, IDS, nid, self.apps[nid],
+                                   str(self.wal_root / nid), native=False)
+        m = Messenger(nid, ("127.0.0.1", 0), self.nodemap)
+        self.nodemap.add(nid, "127.0.0.1", m.port)
+        self.msgs[nid] = m
+        node.attach_messenger(m)
+        node.request_sync()
+        self.nodes[nid] = node
+        for n in self.nodes.values():
+            n.set_alive(IDS.index(nid), True)
+        return node
 
     def close(self):
         for n in self.nodes.values():
@@ -345,3 +375,66 @@ def test_chain_node_epoch_gc_duck_typing(cluster):
     # and for one that exists: drop removes the row before freeing state
     assert coord.drop_final_state("csvc", 0)
     assert coord.get_final_state("csvc", 0) is None
+
+
+@pytest.mark.parametrize("seed", [3, 14])
+def test_chain_random_kill_restart_released_writes_converge(tmp_path, seed):
+    """Randomized chain durability: random commits under random single-node
+    kills (head, mid, or tail) + journal restarts with backlog resets —
+    every write whose response was RELEASED to a client (including late
+    releases after the submitter stopped waiting) converges onto every
+    node's app (the chain twin of the Mode B paxos property in
+    tests/test_modeb.py)."""
+    import numpy as _np
+
+    rng = _np.random.default_rng(seed)
+    cl = Cluster(make_cfg(), wal_root=tmp_path)
+    pending = {}  # key -> (value, done-list); folded into released at end
+    dead = None
+    try:
+        cl.create("svc")
+        cnt = 0
+        for step in range(24):
+            if dead is None and rng.random() < 0.3:
+                dead = IDS[int(rng.integers(0, 3))]
+                cl.kill(dead)
+            elif dead is not None and rng.random() < 0.45:
+                cl.drop_backlog(dead)
+                cl.restart(dead)
+                dead = None
+            at = str(rng.choice([i for i in IDS if i != dead]))
+            cnt += 1
+            k, v = f"h{cnt}", str(step)
+            done = []
+            if cl.nodes[at].propose("svc", f"PUT {k} {v}".encode(),
+                                    lambda _r, x: done.append(x)) is None:
+                continue
+            pending[k] = (v, done)
+            for _ in range(300):
+                cl.ticks(1)
+                if done:
+                    break
+        if dead is not None:
+            cl.drop_backlog(dead)
+            cl.restart(dead)
+
+        def released():
+            # late releases count: a response that fired after its
+            # submitter stopped waiting is still a client-visible promise
+            return {k: v for k, (v, d) in pending.items() if b"OK" in d}
+
+        deadline = time.monotonic() + 150
+        while time.monotonic() < deadline:
+            cl.ticks(1)
+            rel = released()
+            if rel and all(cl.apps[nid].db.get("svc", {}).get(k) == v
+                           for nid in IDS for k, v in rel.items()):
+                break
+        rel = released()
+        for nid in IDS:
+            db = cl.apps[nid].db.get("svc", {})
+            missing = {k: v for k, v in rel.items() if db.get(k) != v}
+            assert not missing, (nid, len(missing))
+        assert rel
+    finally:
+        cl.close()

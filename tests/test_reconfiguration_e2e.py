@@ -343,3 +343,69 @@ def test_recreate_survives_stale_drop_of_old_incarnation():
             client.close()
         for s in srv.values():
             s.close()
+
+
+@pytest.mark.parametrize("seed", [2, 6])
+def test_random_control_plane_churn(seed):
+    """Randomized control-plane churn through the real deployment: random
+    create / write / migrate / delete / recreate across names, asserting
+    read-your-writes across every epoch change, duplicate-create rejection,
+    deleted-name fencing, and full model agreement at the end (the
+    randomized twin of the ordered TESTReconfigurationClient methods,
+    reconfiguration/testing/TESTReconfigurationClient.java:676-1002)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    cfg = make_cfg()
+    cfg.paxos.max_groups = 96
+    cluster = InProcessCluster(cfg, KVApp)
+    client = ReconfigurableAppClient(cfg.nodes)
+    ar = cfg.nodes.active_ids()
+    model = {}  # name -> expected KV dict (None = deleted)
+    try:
+        for step in range(40):
+            op = rng.choice(["create", "write", "migrate", "delete"],
+                            p=[0.2, 0.4, 0.25, 0.15])
+            name = f"churn{int(rng.integers(0, 6))}"
+            if op == "create":
+                resp = client.create(name, timeout=120)
+                if model.get(name) is None:
+                    assert resp["ok"], (step, name, resp)
+                    model[name] = {}
+                else:
+                    # a timed-out-then-retried create maps 'exists' to
+                    # ok=True (created_by_earlier_attempt) — only a CLEAN
+                    # ok on a live name is a duplicate-create bug
+                    assert (not resp["ok"]
+                            or resp.get("note") == "created_by_earlier_attempt"),                         (step, name, resp)
+            elif model.get(name) is None:
+                continue
+            elif op == "write":
+                k, v = f"k{int(rng.integers(0, 4))}", f"v{step}"
+                assert client.request(name, f"PUT {k} {v}".encode(),
+                                      timeout=90) == b"OK"
+                model[name][k] = v
+            elif op == "migrate":
+                base = int(rng.integers(0, len(ar)))
+                new = [ar[(base + j) % len(ar)] for j in range(3)]
+                assert client.reconfigure(name, new, timeout=120)["ok"]
+                for k, v in model[name].items():  # read-your-writes
+                    assert client.request(name, f"GET {k}".encode(),
+                                          timeout=90) == v.encode()
+            elif op == "delete":
+                resp = client.delete(name, timeout=120)
+                model[name] = None
+                # a slow first attempt can succeed while its retry answers
+                # not-ok against the WAIT_DELETE record — the authoritative
+                # outcome is the fence, asserted either way below
+                with pytest.raises((ClientError, TimeoutError)):
+                    client.request(name, b"GET k0", timeout=8)
+        for name, st in model.items():
+            if st is None:
+                continue
+            for k, v in st.items():
+                assert client.request(name, f"GET {k}".encode(),
+                                      timeout=90) == v.encode()
+    finally:
+        client.close()
+        cluster.close()

@@ -158,6 +158,13 @@ class PaxosManager:
         #: (replica, row) transfers noticed during tick completion, run at
         #: the next tick() top after a pipeline drain (watermark/blob skew)
         self._lag_sync_due: list = []
+        #: HOST-APPLIED execution watermark [R, G]: how far each replica's
+        #: app has actually executed (device exec_slot runs one pipelined
+        #: tick ahead of it).  The payload sweep must judge "everyone
+        #: passed this slot" against THIS, not device state: a payload
+        #: swept in the gap makes the very delivery that advanced the
+        #: device watermark skip host-side — a silent lost write
+        self._host_exec = np.zeros((self.R, self.G), np.int32)
         # ---- device-resident application (models/device_kv.py) ----
         self._device_app = bool(cfg.paxos.device_app)
         self.kv = None
@@ -310,6 +317,7 @@ class PaxosManager:
         self._member_ord = None
 
     def _clear_member_rows(self, rows) -> None:
+        self._host_exec[:, rows] = 0  # recycled rows restart at slot 0
         self._member_np[:, rows] = False
         self._n_members_np[rows] = 0
         self._member_bits[rows] = 0
@@ -515,6 +523,10 @@ class PaxosManager:
         )
         self._set_member_row(row, mask[0], name)
         self.state = st.hot_restore(self.state, row, hri)
+        # pause spills drained state (host == device), so the restored
+        # device watermark is also the host-applied one for this row
+        self._host_exec[:, row] = np.asarray(
+            self.state.exec_slot[:, row]).astype(np.int32)
         if self.kv is not None and "dkv_key" in hri:
             self.kv = self.kv._replace(
                 key=self.kv.key.at[:, row].set(jnp.asarray(hri["dkv_key"])),
@@ -1354,6 +1366,9 @@ class PaxosManager:
                         slot = int(eb[r, row]) + j
                         is_stop = bool(es[r, j, row])
                         self._execute_one(r, int(row), name, rid, slot, is_stop)
+        np.maximum(self._host_exec,
+                   np.asarray(out.exec_base) + np.asarray(out.exec_count),
+                   out=self._host_exec)
         self.stats["decisions"] += int(out.decided_now.sum())
         # Self-heal laggards in FULL-outbox mode too (the compact path has
         # the twin block in _process_compact): a replica >= W behind can
@@ -1490,6 +1505,10 @@ class PaxosManager:
             rows = co.e_row[:n]
             slots = co.e_slot[:n]
             stops = co.e_stop[:n]
+            # host-applied execution watermark (see _host_exec): these
+            # entries are being delivered to the apps RIGHT NOW
+            np.maximum.at(self._host_exec, (reps, rows),
+                          slots.astype(np.int32) + 1)
             valid = rids != NO_REQUEST
             # noop decisions (gap fills): stats parity with _execute_one
             self.stats["noops"] += int((~valid & ~stops).sum())
@@ -1611,14 +1630,21 @@ class PaxosManager:
         if not self.outstanding and (self.bulk is None
                                      or self.bulk.n_live == 0):
             return
-        exec_slot = np.array(self.state.exec_slot)
+        # "passed" is judged against the HOST-APPLIED watermark (see
+        # _host_exec): device exec includes the in-flight pipelined tick's
+        # executions, whose host deliveries still need their payloads
+        exec_slot = self._host_exec
+        dev_exec = np.array(self.state.exec_slot)
         if self.bulk is not None and self.bulk.n_live:
             # vectorized twin for the store
             s = self.bulk
             member_exec = np.where(self._member_np, exec_slot,
                                    np.iinfo(np.int32).max)
             amin = member_exec.min(axis=0)  # [G] min ALL-member watermark
-            base = np.where(self._member_np, exec_slot,
+            # rotation uses the DEVICE watermark (ring overwrite is a
+            # device-side fact); repair blobs cover it because transfers
+            # capture pipeline-drained, host==device state
+            base = np.where(self._member_np, dev_exec,
                             np.iinfo(np.int32).min).max(axis=0)  # [G]
             any_live = (self._member_np & self.alive[:, None]).any(axis=0)
             # rotation bound is STRICT: executed-through base-1 only proves
@@ -1648,8 +1674,9 @@ class PaxosManager:
             if not any(self.alive[m] for m in ms):
                 continue
             marks = [int(exec_slot[m, rec.row]) for m in ms]
+            dbase = max(int(dev_exec[m, rec.row]) for m in ms)
             if (all(mk > rec.slot for mk in marks)
-                    or rec.slot < max(marks) - self.W):  # strict: see above
+                    or rec.slot < dbase - self.W):  # strict: see above
                 dead.append(rid)
         for rid in dead:
             self._row_outstanding[self.outstanding[rid].row] -= 1
@@ -1720,6 +1747,8 @@ class PaxosManager:
                            ckpt: bytes) -> None:
         old_exec = int(np.asarray(self.state.exec_slot[r, row]))
         self.apps[r].restore(name, ckpt)
+        self._host_exec[r, row] = max(int(self._host_exec[r, row]),
+                                      donor_exec)
         self.state = self.state._replace(
             exec_slot=self.state.exec_slot.at[r, row].set(donor_exec),
             status=self.state.status.at[r, row].set(donor_status),

@@ -422,6 +422,11 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
             meta, npz_blob = records.loads(f.read())
         arrs = np.load(io.BytesIO(npz_blob))
         m.state = PaxosState(**{f: jnp.asarray(arrs[f]) for f in PaxosState._fields})
+        # checkpoints are taken pipeline-drained (host == device), so the
+        # snapshot's device watermark IS the host-applied one; leaving
+        # _host_exec at zero would disable the sweep's passed-branch until
+        # every member executes again post-recovery
+        m._host_exec = np.asarray(m.state.exec_slot).astype(np.int32).copy()
         m._member_np = np.asarray(m.state.member).copy()
         m._n_members_np = np.asarray(m.state.n_members).copy()
         m.tick_num = meta["tick_num"]

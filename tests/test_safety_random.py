@@ -128,7 +128,8 @@ def test_noop_decisions_allowed():
 
 
 @pytest.mark.parametrize("seed,compact", [(7, False), (13, False),
-                                           (32, False), (7, True)])
+                                           (32, False), (128, False),
+                                           (7, True), (128, True)])
 def test_manager_random_crash_recover_pipelined(tmp_path, seed, compact):
     """Manager-level randomized safety with PIPELINED ticks + WAL: random
     request arrivals, random replica crash/recover (majority kept alive),
@@ -137,11 +138,14 @@ def test_manager_random_crash_recover_pipelined(tmp_path, seed, compact):
     exactly-once, and the recovered KV state must agree with a sequential
     replay of the committed responses.
 
-    The three seeds each caught a distinct silent-loss bug in a 40-seed
-    soak (round 5): 7 = sync watermark/blob pipeline skew (donor device
+    Each non-default seed caught a distinct silent-loss bug in the
+    round-5 soaks: 7 = sync watermark/blob pipeline skew (donor device
     watermark paired with host app state one tick behind), 13 = payload
     swept while a dead member could still ring-replay its slot on
-    revival, 32 = the sweep rotation bound off-by-one at slot == base-W."""
+    revival, 32 = the sweep rotation bound off-by-one at slot == base-W,
+    128 = the sweep judging "everyone passed" from DEVICE exec, which
+    includes the in-flight pipelined tick — dropping the payload of the
+    very delivery that advanced it (the _host_exec watermark fix)."""
     import os
 
     from gigapaxos_tpu.config import GigapaxosTpuConfig

@@ -19,7 +19,8 @@ Env knobs: GPTPU_BENCH_GROUPS (default 1<<20), GPTPU_BENCH_TICKS (default 30),
 GPTPU_BENCH_REPLICAS (3), GPTPU_BENCH_WINDOW (8), GPTPU_BENCH_PLATFORM
 (force a jax platform, e.g. "cpu"; also disables the fallback recursion),
 GPTPU_BENCH_APP=device_kv (fuse the device-resident KV app behind the tick —
-decisions execute on-device, models/device_kv.py).
+decisions execute on-device, models/device_kv.py), GPTPU_BENCH_LAT_TICKS
+(default 15; 0 disables the closed-loop commit-latency phase).
 """
 
 import json
@@ -152,16 +153,46 @@ def run_bench() -> dict:
         dt = time.perf_counter() - t0
 
     dps = total_decisions / dt
+
+    # Closed-loop commit-latency phase: the throughput loop above queues
+    # ticks open-loop, so its wall time says nothing about how long ONE
+    # wave takes from request entry to decision visible on the host.  Here
+    # each tick blocks before the next is dispatched — entry-to-commit
+    # latency of a full wave, the per-request commit latency at 1 req/group
+    # (the TESTPaxosClient RTT column's kernel-path analog).
+    lat_ticks = int(os.environ.get("GPTPU_BENCH_LAT_TICKS", 15))
+    lat_p50 = lat_p99 = None
+    if lat_ticks > 0:
+        if use_scan:  # the scan path never built the single-tick program
+            step_j = jax.jit(tick_once, donate_argnums=(0,))
+        base0 = 1 + 2 * (n_ticks + 1) * G  # past every rid the loops used
+        carry = step_j(carry, jnp.int32(base0))  # (re)compile + warm
+        jax.block_until_ready(carry[-1])
+        lats = []
+        for i in range(lat_ticks):
+            t0 = time.perf_counter()
+            carry = step_j(carry, jnp.int32(base0 + (i + 1) * G))
+            jax.block_until_ready(carry[-1])
+            lats.append(time.perf_counter() - t0)
+        lat_p50 = float(np.percentile(lats, 50)) * 1e3
+        lat_p99 = float(np.percentile(lats, 99)) * 1e3
+
     backend = jax.devices()[0].platform
     suffix = f"_{backend}" if backend not in ("tpu", "axon") else ""
     app_tag = "_device_kv" if device_app else ""
-    return {
+    result = {
         "metric": (f"decisions_per_sec_per_chip_{G}_groups_{R}_replicas"
                    f"{app_tag}{suffix}"),
         "value": round(dps, 1),
         "unit": "decisions/s",
         "vs_baseline": round(dps / BASELINE_DECISIONS_PER_SEC, 2),
     }
+    if lat_p50 is not None:
+        result["commit_latency_ms"] = {
+            "p50": round(lat_p50, 3), "p99": round(lat_p99, 3),
+            "closed_loop_ticks": lat_ticks,
+        }
+    return result
 
 
 def _cpu_fallback(diag: dict) -> dict:

@@ -32,7 +32,14 @@ class PaxosTuning:
     # Out-of-order window W per group: ring-buffer depth for accepted pvalues
     # and undelivered decisions (replaces the reference's sparse
     # accepted/committed maps, PaxosAcceptor.java:108-115).  Power of two.
-    window: int = 8
+    # Default 4: tick cost scales with W (the ring gathers do W-way selects
+    # over W planes), and at the 1M-group design point W=8 measured 84.5k
+    # dec/s vs 193.9k at W=4 (benchmarks/results_r5.json).  Raise it for
+    # workloads with deep per-group pipelining or laggy replicas: a replica
+    # more than W slots behind can no longer catch up from the decision
+    # ring and needs a full checkpoint transfer (gap-sync; see README
+    # "Choosing the window").
+    window: int = 4
     # Max replicas per group (padding width of the member table).
     max_replicas: int = 3
     # Max new proposals accepted per group per tick at each entry replica.
@@ -115,6 +122,20 @@ class PaxosTuning:
     # the request still rides the normal consensus stream so OTHER replicas
     # converge eventually (response latency excludes the quorum round).
     lazy_propagation: bool = False
+    # Sharded data plane (parallel/shard_tick): partition the dense state
+    # over a (replica, groups) device mesh and run the tick as a shard_map
+    # program — each shard computes on its concrete local block (the pallas
+    # ring gather stays enabled per-shard) and cross-replica quorum exchange
+    # is an explicit all_gather over the replica mesh axis.  0 = off
+    # (single-device program); -1 = all visible devices; N > 0 = first N
+    # devices.  Device count must be divisible by mesh_replica_shards, and
+    # the replica/group dims by their shard counts.
+    mesh_devices: int = 0
+    # How many shards the replica axis splits into (the rest of the mesh
+    # devices form the groups axis, which never communicates).  1 = pure
+    # group-data-parallelism, zero collectives in the hot phases (the
+    # v5e-4 deployment shape).
+    mesh_replica_shards: int = 1
     # Tick coalescing: minimum spacing between driver ticks while busy.
     # Each tick has a fixed host cost (admission, placement, compaction
     # unpack); spacing ticks lets requests accumulate so that cost

@@ -50,6 +50,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..ops.pallas_gather import shard_local_trace
 from ..ops.tick import TickInbox, paxos_tick_impl
 
 #: own-row state fields shipped in replica frames ([R, G] / [R, W, G])
@@ -70,7 +71,12 @@ def node_tick_impl(state, inbox: TickInbox, r: int):
     the batching analog of PaxosPacketBatcher coalescing per-peer traffic,
     gigapaxos/PaxosPacketBatcher.java:28-35).
     """
-    new, out = paxos_tick_impl(state, inbox, own_row=r)
+    # a node program is single-device by construction (each Mode-B process
+    # owns one chip) — never GSPMD-partitioned — so the Pallas gathers are
+    # safe here even when the host exposes multiple devices, where the
+    # backend-wide heuristic in use_pallas_gather() would refuse them
+    with shard_local_trace():
+        new, out = paxos_tick_impl(state, inbox, own_row=r)
     R = state.exec_slot.shape[0]
     row2 = (jnp.arange(R) == r)[:, None]        # [R, 1]
     row3 = row2[:, None, :]                      # [R, 1, 1]

@@ -21,9 +21,11 @@ fallback (CPU tests, interpret mode) and the semantic reference
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import math
 import os
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -74,12 +76,14 @@ def _build(lead: int, wp: int, j_out: int, g: int, dtype_name: str,
     )
 
 
-def gather_planes_pallas(arr, idx, interpret: bool = False):
+def gather_planes_pallas(arr, idx, interpret: bool | None = None):
     """Drop-in for ``window.gather_planes`` on TPU.
 
     ``arr``: ``[..., Wp, G]``; ``idx``: ``[J, G]`` (shared across leading
     dims) or ``[..., J, G]``.  Lanes G must be a multiple of 128.
     """
+    if interpret is None:
+        interpret = default_interpret()
     wp, g = arr.shape[-2], arr.shape[-1]
     j_out = idx.shape[-2]
     lead_shape = arr.shape[:-2]
@@ -134,9 +138,11 @@ def _build_match(e_planes: int, j_out: int, g: int, dtype_name: str,
     )
 
 
-def match_planes_pallas(vals, keys, idx, interpret: bool = False):
+def match_planes_pallas(vals, keys, idx, interpret: bool | None = None):
     """Per-lane key-match select (see window.match_planes): ``vals``/``keys``
     ``[E, G]``, ``idx`` ``[J, G]`` -> ``[J, G]``."""
+    if interpret is None:
+        interpret = default_interpret()
     e_planes, g = vals.shape
     j_out = idx.shape[0]
     squeeze_bool = vals.dtype == jnp.bool_
@@ -147,21 +153,60 @@ def match_planes_pallas(vals, keys, idx, interpret: bool = False):
     return out.astype(jnp.bool_) if squeeze_bool else out
 
 
+_tls = threading.local()
+
+
+@contextlib.contextmanager
+def shard_local_trace():
+    """Mark the enclosed trace as a shard_map body.
+
+    Inside a shard_map body every operand is a concrete per-device block, so
+    the pallas kernel is safe (and profitable) even when the program as a
+    whole spans a multi-device mesh — the GSPMD operand-replication hazard
+    that disables it below only applies to global-view tracing.  The flag is
+    thread-local because jit tracing of independent programs can race across
+    threads (driver thread vs. test thread)."""
+    prev = getattr(_tls, "shard_local", False)
+    _tls.shard_local = True
+    try:
+        yield
+    finally:
+        _tls.shard_local = prev
+
+
+def in_shard_local_trace() -> bool:
+    return getattr(_tls, "shard_local", False)
+
+
 @functools.lru_cache(maxsize=1)
+def _backend_info():
+    try:
+        return jax.default_backend(), len(jax.devices())
+    except Exception:
+        return None, 0
+
+
+def default_interpret() -> bool:
+    """Pallas interpret mode default (env GPTPU_PALLAS_INTERPRET=1): lets the
+    CPU suite execute the real kernel path end-to-end inside shard_map."""
+    return bool(os.environ.get("GPTPU_PALLAS_INTERPRET"))
+
+
 def use_pallas_gather() -> bool:
     """True when the fused ticks should route plane gathers through the
-    pallas kernel: TPU-class default backend, single device (under GSPMD a
-    pallas custom call without a sharding rule would replicate its [R, W, G]
-    operands across the mesh — the sharded path keeps the XLA select chain,
-    whose replica-axis reductions lower to ICI collectives).  Overrides:
-    GPTPU_NO_PALLAS=1 forces off, GPTPU_PALLAS=1 forces on."""
+    pallas kernel.  Default policy: TPU-class backend AND either a single
+    device or a shard_map body trace (``shard_local_trace``) — under plain
+    GSPMD a pallas custom call without a sharding rule would replicate its
+    [R, W, G] operands across the mesh, so the global-view sharded path
+    keeps the XLA select chain; inside shard_map each shard's block is
+    concrete and the kernel runs per-shard.  Overrides: GPTPU_NO_PALLAS=1
+    forces off, GPTPU_PALLAS=1 forces on (pair with GPTPU_PALLAS_INTERPRET=1
+    off-TPU)."""
     if os.environ.get("GPTPU_NO_PALLAS"):
         return False
     if os.environ.get("GPTPU_PALLAS"):
         return True
-    try:
-        backend = jax.default_backend()
-        n_dev = len(jax.devices())
-    except Exception:
+    backend, n_dev = _backend_info()
+    if backend not in ("tpu", "axon"):
         return False
-    return backend in ("tpu", "axon") and n_dev == 1
+    return n_dev == 1 or in_shard_local_trace()

@@ -108,9 +108,16 @@ def _lexmax(n, c, axis):
 
 
 def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
-                    exec_budget: int = 0):
+                    exec_budget: int = 0, group_axis: str | None = None):
     """Un-jitted tick body (jit/shard it yourself; `paxos_tick` below is the
     ready-made single-program jit with state donation).
+
+    group_axis: name of a mesh axis the group dimension G is sharded over
+    when this body is traced inside a shard_map (``parallel/shard_tick``).
+    Every per-group computation is oblivious to it; only the exec_budget
+    ranking below crosses groups, and with ``group_axis`` set it exchanges
+    per-(j, r) block counts over that axis so the global rank — and hence
+    the kept execution set — is bit-identical to the unsharded program.
 
     exec_budget: 0 = unlimited.  > 0 caps the TOTAL executions extracted
     this tick across all (replica, group) pairs, cutting each group's
@@ -443,6 +450,33 @@ def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
         em_t = exec_mask.transpose(1, 0, 2)  # [W, R, G]
         fi = em_t.reshape(-1).astype(I32)
         rank = (jnp.cumsum(fi) - fi).reshape(em_t.shape)
+        if group_axis is not None:
+            # G is a shard-local block of a mesh-sharded group axis, but the
+            # flat (j, r, g) enumeration above must rank GLOBALLY (g is the
+            # fastest-varying axis, so shard k's (j, r) row sits after the
+            # same row on shards < k).  Exchange tiny [W, R] per-row counts
+            # and rebase:  global rank = (count before this (j, r) row)
+            # + (this row's count on earlier shards) + (local within-row
+            # rank).  Exact, so budget decisions match the unsharded tick
+            # bit for bit.
+            blk = jnp.sum(em_t, axis=2).astype(I32)  # [W, R] local row counts
+            allblk = jax.lax.all_gather(blk, group_axis)  # [S, W, R]
+            nsh = allblk.shape[0]
+            shard = jax.lax.axis_index(group_axis)
+            total = jnp.sum(allblk, axis=0)  # [W, R] global row counts
+            tf = total.reshape(-1)
+            before_row = (jnp.cumsum(tf) - tf).reshape(total.shape)
+            earlier = jnp.sum(
+                jnp.where(
+                    jnp.arange(nsh, dtype=I32)[:, None, None] < shard,
+                    allblk, 0,
+                ),
+                axis=0,
+            )  # [W, R] same row, shards before this one
+            lf = blk.reshape(-1)
+            row_start = (jnp.cumsum(lf) - lf).reshape(blk.shape)
+            rank = (rank - row_start[:, :, None]
+                    + (before_row + earlier)[:, :, None])
         exec_mask = exec_mask & (
             rank.transpose(1, 0, 2) < exec_budget
         )
@@ -508,7 +542,7 @@ def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
 
 
 paxos_tick = jax.jit(paxos_tick_impl, donate_argnums=(0,),
-                     static_argnums=(2, 3))
+                     static_argnums=(2, 3, 4))
 
 
 class HostOutbox(NamedTuple):

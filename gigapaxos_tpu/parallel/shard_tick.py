@@ -1,0 +1,195 @@
+"""shard_map tick: the per-shard-local formulation of the fused tick.
+
+``parallel/mesh.sharded_tick`` writes global-view code and lets GSPMD
+partition it.  That is correct but slow in exactly the way that matters at
+the BASELINE design point: inside a GSPMD program the Pallas ring gather has
+no sharding rule, so ``use_pallas_gather()`` must disable it and the tick
+falls back to the W²-broadcast XLA select chain — the multi-chip deployment
+runs the unoptimized path.
+
+This module instead wraps the UNCHANGED tick body in
+``jax.experimental.shard_map`` over the (replica, groups) mesh:
+
+* Each shard sees a concrete local ``[R_local(, W), G_local]`` block, so the
+  Pallas kernels run per-shard (``shard_local_trace`` flips
+  ``use_pallas_gather`` back on during body tracing).
+* Cross-replica exchange is explicit: the body ``all_gather``s the
+  replica-led state/inbox fields over the ``replica`` axis (one tiled ICI
+  collective per field — the ACCEPT fan-out / ACCEPT_REPLY fan-in), runs the
+  tick on the full-R local-G block, and slices its own replica rows back
+  out.  Because the math inside the body is the verbatim single-device
+  ``paxos_tick_impl`` over gathered operands, results are bit-identical to
+  the unsharded tick by construction — the quorum tallies, lexicographic
+  ballot maxes, and promise cross-products never get re-associated by a
+  partitioner.
+* The groups axis never communicates, except the exec-budget global ranking,
+  which exchanges a tiny [W, R] count block (see ``group_axis`` in
+  ``paxos_tick_impl``).
+* With ``replica_shards == 1`` (the v5e-4 deployment shape: 4 chips on the
+  groups axis) the gathers degenerate to no-ops and the program is pure
+  data-parallel with zero collectives in the hot phases.
+
+Outbox pack / compaction stays OUTSIDE the shard_map (global-view GSPMD):
+the compact prefix-scatter is a global cumsum over all groups, and keeping
+it global means ``CompactLayout`` / ``unpack_compact`` and the whole host
+loop are byte-compatible with the single-device path.  It runs as a SECOND
+jit dispatch, not fused into the tick program: on this jax version,
+consuming ``shard_map(check_rep=False)`` outputs downstream *in the same
+jit* miscompiles — even a plain concatenate of the outbox fields returns
+wrong values, and reductions come back multiplied by the groups-axis size
+(the partitioner double-reduces the already-assembled outputs).  Across a
+dispatch boundary the outbox is an ordinary committed sharded array and the
+GSPMD pack/compact program is correct (verified bit-identical in
+tests/test_sharding_stack.py).  Cost: one extra ~100us dispatch per tick;
+the outbox intermediate stays device-resident and sharded either way.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import tick as tk
+from ..ops.pallas_gather import shard_local_trace
+from ..ops.tick import TickInbox, TickOutbox
+from ..paxos.state import PaxosState
+from .mesh import (GROUPS_AXIS, REPLICA_AXIS, _INBOX_SPECS, _STATE_SPECS,
+                   inbox_shardings, state_shardings)
+
+# state fields with a leading replica axis: gathered across replica shards
+# on entry to the body, sliced back to local rows on exit.
+_REPLICA_LED = tuple(
+    f for f, spec in _STATE_SPECS.items()
+    if len(spec) and spec[0] == REPLICA_AXIS
+)
+
+_RWG = P(REPLICA_AXIS, None, GROUPS_AXIS)
+_RG = P(REPLICA_AXIS, GROUPS_AXIS)
+_OUTBOX_SPECS = dict(
+    exec_req=_RWG,
+    exec_stop=_RWG,
+    exec_base=_RG,
+    exec_count=_RG,
+    intake_taken=_RWG,
+    # [G] fields are computed from replica-gathered operands, hence
+    # deterministically identical on every replica shard: replicated.
+    coord_id=P(GROUPS_AXIS),
+    decided_now=P(GROUPS_AXIS),
+    lag=_RG,
+)
+
+
+def validate_mesh_for(mesh: Mesh, R: int, G: int) -> None:
+    rs = mesh.shape[REPLICA_AXIS]
+    gs = mesh.shape[GROUPS_AXIS]
+    if R % rs:
+        raise ValueError(f"replica dim {R} not divisible by {rs} shards")
+    if G % gs:
+        raise ValueError(f"group dim {G} not divisible by {gs} shards")
+
+
+def shard_tick_body(mesh: Mesh, own_row: int = -1, exec_budget: int = 0):
+    """The shard_map-wrapped tick: (state, inbox) -> (state, TickOutbox).
+
+    Not jitted — compose it (e.g. with pack/compact stages) and jit the
+    whole program; see the ``make_shardmap_tick*`` builders below.
+    """
+    rs = mesh.shape[REPLICA_AXIS]
+    gs = mesh.shape[GROUPS_AXIS]
+    group_axis = GROUPS_AXIS if gs > 1 else None
+
+    def body(state, inbox):
+        if rs > 1:
+            def ag(x):
+                return jax.lax.all_gather(x, REPLICA_AXIS, axis=0, tiled=True)
+
+            state = state._replace(
+                **{f: ag(getattr(state, f)) for f in _REPLICA_LED}
+            )
+            inbox = inbox._replace(req=ag(inbox.req), stop=ag(inbox.stop))
+        with shard_local_trace():
+            new, out = tk.paxos_tick_impl(
+                state, inbox, own_row, exec_budget, group_axis=group_axis
+            )
+        if rs > 1:
+            ri = jax.lax.axis_index(REPLICA_AXIS)
+            rloc = new.exec_slot.shape[0] // rs
+
+            def sl(x):
+                return jax.lax.dynamic_slice_in_dim(x, ri * rloc, rloc, axis=0)
+
+            new = new._replace(**{f: sl(getattr(new, f)) for f in _REPLICA_LED})
+            out = out._replace(
+                exec_req=sl(out.exec_req),
+                exec_stop=sl(out.exec_stop),
+                exec_base=sl(out.exec_base),
+                exec_count=sl(out.exec_count),
+                intake_taken=sl(out.intake_taken),
+                lag=sl(out.lag),
+            )
+        return new, out
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(PaxosState(**_STATE_SPECS), TickInbox(**_INBOX_SPECS)),
+        out_specs=(PaxosState(**_STATE_SPECS), TickOutbox(**_OUTBOX_SPECS)),
+        # the body mixes collectives with device-varying slicing (and pallas
+        # calls, which have no replication rule); skip static rep checking.
+        check_rep=False,
+    )
+
+
+def make_shardmap_tick(mesh: Mesh, own_row: int = -1, exec_budget: int = 0):
+    """Jitted shard_map tick returning the full TickOutbox (test/debug)."""
+    body = shard_tick_body(mesh, own_row, exec_budget)
+    return jax.jit(
+        body,
+        in_shardings=(state_shardings(mesh), inbox_shardings(mesh)),
+        donate_argnums=(0,),
+    )
+
+
+def fetch_host_outbox(out: TickOutbox) -> "tk.HostOutbox":
+    """Assemble the full outbox on the host directly from the sharded fields.
+
+    The mesh full-outbox path skips the on-device ``pack_outbox_impl``: on
+    this jax version a GSPMD concatenate over the mixed-sharding outbox
+    fields returns wrong values (same partitioner issue as the same-jit
+    fusion, see module docstring), while per-field assembly from the
+    committed shards is exact and moves the same bytes.  Full-outbox mode is
+    the small-scale/debug path; at scale the compact path is the transfer
+    that matters.
+    """
+    jax.block_until_ready(out)
+    return tk.HostOutbox(*(np.asarray(f) for f in out))
+
+
+def make_shardmap_tick_compact(mesh: Mesh, own_row: int, exec_budget: int,
+                               lag_budget: int):
+    """shard_map tick + budgeted on-device compaction (O(budget) transfer).
+
+    The compaction stage runs global-view over the sharded outbox in its own
+    dispatch (see module docstring) — its prefix-sum scatter ranks
+    executions across ALL groups, and the flat buffer layout
+    (``CompactLayout``) stays identical to the single-device path so the
+    manager's unpack/WAL/replay code needs no sharded variant.
+    """
+    tick = make_shardmap_tick(mesh, own_row, exec_budget)
+    compact = jax.jit(
+        functools.partial(
+            tk._compact_outbox_impl,
+            exec_budget=exec_budget, lag_budget=lag_budget,
+        ),
+        donate_argnums=(0,),
+    )
+
+    def fn(state, inbox):
+        state, out = tick(state, inbox)
+        return state, compact(out)
+
+    return fn

@@ -192,6 +192,16 @@ class BulkStore:
         self.n_live -= len(li)
         return len(li)
 
+    def live_by_row(self, n_rows: int) -> np.ndarray:
+        """Live-request count per group row ``[n_rows]``.
+
+        The mesh benchmark's shard-balance probe: a groups-axis shard owns a
+        contiguous row range, so binning these counts per shard exposes
+        intake skew (one shard absorbing most of the admission work while
+        the others idle through the tick)."""
+        live = np.nonzero(self.valid)[0]
+        return np.bincount(self.row[live], minlength=n_rows)
+
     # -------------------------------------------------------------- snapshot
     def snapshot(self) -> dict:
         """Dense snapshot of live entries only (for WAL checkpoints)."""

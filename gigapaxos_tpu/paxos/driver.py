@@ -45,9 +45,18 @@ class TickDriver:
         """Wake the driver immediately (call after enqueuing proposals)."""
         self._kick.set()
 
-    def wait_ready(self, timeout_s: float = 120.0) -> bool:
+    def wait_ready(self, timeout_s: float | None = None) -> bool:
         """Block until the first tick completed — i.e. the jitted step is
-        compiled and the plane answers at interactive latency."""
+        compiled and the plane answers at interactive latency.
+
+        Default timeout is 120s, tripled for mesh managers: the shard_map
+        tick compiles one SPMD program per mesh plus the separate
+        pack/compact dispatch, which takes several times longer than the
+        single-device program (worst on the 8-way virtual CPU mesh the
+        tests use)."""
+        if timeout_s is None:
+            timeout_s = 360.0 if getattr(self.manager, "mesh", None) \
+                is not None else 120.0
         return self._first_tick.wait(timeout=timeout_s)
 
     def stop(self) -> None:

@@ -196,6 +196,50 @@ class PaxosManager:
             self._kv_chunks: list = []  # staged descriptor uploads
             self._kv_watermark = 0  # highest rid with descriptor on device
             self._kv_uploaded = None  # this tick's upload (journaled)
+        # ---- sharded data plane (parallel/shard_tick) ----
+        # mesh_devices > 0 (or -1 = all): state lives partitioned over a
+        # (replica, groups) device mesh and the tick runs as a shard_map
+        # program — pallas gathers stay enabled per-shard, quorum exchange
+        # is an explicit replica-axis all_gather.  Bit-identical to the
+        # single-device path (tests/test_sharding_stack.py), so everything
+        # downstream (WAL, replay, laggard repair, compaction layout) is
+        # unchanged.
+        self.mesh = None
+        self._mesh_tick = None
+        self._mesh_tick_compact = None
+        if cfg.paxos.mesh_devices:
+            import jax
+
+            from ..parallel import shard_tick as _stk
+            from ..parallel.mesh import make_mesh, state_shardings
+
+            if self._device_app:
+                raise ValueError(
+                    "device_app + mesh_devices is not supported yet: the "
+                    "fused KV program has no shard_map formulation"
+                )
+            devs = jax.devices()
+            n = len(devs) if cfg.paxos.mesh_devices < 0 else cfg.paxos.mesh_devices
+            if n > len(devs):
+                raise ValueError(
+                    f"mesh_devices={n} but only {len(devs)} devices visible"
+                )
+            self.mesh = make_mesh(
+                devs[:n], replica_shards=cfg.paxos.mesh_replica_shards
+            )
+            _stk.validate_mesh_for(self.mesh, self.R, self.G)
+            if self._use_compact:
+                self._mesh_tick_compact = _stk.make_shardmap_tick_compact(
+                    self.mesh, -1, self._exec_budget, self._lag_budget
+                )
+            else:
+                self._mesh_tick = _stk.make_shardmap_tick(self.mesh, -1)
+            # recreate the state distributed (each device materializes only
+            # its shard; no single-device peak)
+            self.state = st.init_state(
+                self.R, self.G, self.W,
+                shardings=state_shardings(self.mesh),
+            )
         # first-occurrence scratch (generation-tagged so no per-tick clear)
         self._scr_pos = np.zeros(self.R * self.G, np.int64)
         self._scr_gen = np.zeros(self.R * self.G, np.int64)
@@ -210,6 +254,9 @@ class PaxosManager:
         #: pipelined mode: (outbox, placed) of the last dispatched tick,
         #: consumed at the start of the next (SURVEY §2.2 item 3)
         self._pending_out = None
+        #: completed outbox stashed by drain_pipeline() for the next tick()
+        #: to return (sync-due ticks must not swallow an outbox)
+        self._drained_out = None
         #: lock-free propose staging (drained at each tick; deque append/
         #: popleft are thread-safe) + a tiny rid-assignment lock that never
         #: contends with the tick
@@ -1251,6 +1298,12 @@ class PaxosManager:
                 self.state, self.kv, inbox, *reg, -1,
                 self._exec_budget, self._lag_budget,
             )
+        elif self._mesh_tick_compact is not None:
+            # numpy inbox: committed to the mesh layout by in_shardings on
+            # entry, as is the state after any eager admin-op mutation
+            self.state, packed = self._mesh_tick_compact(self.state, inbox)
+        elif self._mesh_tick is not None:
+            self.state, packed = self._mesh_tick(self.state, inbox)
         elif self._use_compact:
             self.state, packed = paxos_tick_compact(
                 self.state, inbox, -1, self._exec_budget, self._lag_budget
@@ -1274,7 +1327,12 @@ class PaxosManager:
                 # may reach drain_pipeline (pause_idle) — must not re-enter
                 out = self._complete_tick(*prev)
             else:
-                out = None
+                # nothing pending this tick — but drain_pipeline (laggard
+                # sync, checkpoint) may have completed the previous tick's
+                # outbox moments ago; hand that stashed result out instead
+                # of dropping it, so callers polling tick() never miss a
+                # completed outbox on sync-due ticks
+                out, self._drained_out = self._drained_out, None
             self._pending_out = (packed, placed, bulk_placed)
             # a due checkpoint must cover on-host effects of every tick the
             # device state contains — drain the one-tick pipeline first
@@ -1301,8 +1359,17 @@ class PaxosManager:
                 e_resp, e_miss = self._compact_layout.kv_extras(flat)
             self._process_compact(out, placed, bulk_placed, e_resp, e_miss)
         else:
-            out = (packed if isinstance(packed, HostOutbox)
-                   else unpack_outbox(packed, self.R, self.P, self.W, self.G))
+            if isinstance(packed, HostOutbox):
+                out = packed
+            elif self.mesh is not None:
+                # mesh full-outbox mode: the tick returns the raw sharded
+                # TickOutbox — assemble per-field on the host (the on-device
+                # pack miscompiles over mixed shardings; see shard_tick)
+                from ..parallel.shard_tick import fetch_host_outbox
+
+                out = fetch_host_outbox(packed)
+            else:
+                out = unpack_outbox(packed, self.R, self.P, self.W, self.G)
             self._process_outbox(out, placed, bulk_placed)
         self._flush_callbacks()
         if self.tick_num % 64 == 0:
@@ -1318,11 +1385,14 @@ class PaxosManager:
     @_locked
     def drain_pipeline(self) -> None:
         """Synchronously finish the pending pipelined outbox (no-op when
-        nothing is pending or pipelining is off)."""
+        nothing is pending or pipelining is off).  The completed outbox is
+        stashed for the next tick() to return — draining (laggard sync, due
+        checkpoint) must not make a tick's outbox vanish from the caller's
+        point of view."""
         if self._pending_out is not None:
             prev = self._pending_out
             self._pending_out = None
-            self._complete_tick(*prev)
+            self._drained_out = self._complete_tick(*prev)
 
     def _flush_callbacks(self) -> None:
         """Release client responses only once the WAL covering their tick is

@@ -98,8 +98,25 @@ class PaxosState(NamedTuple):
         return self.acc_req.shape[1]
 
 
-def init_state(n_replicas: int, n_groups: int, window: int) -> PaxosState:
-    """All rows FREE; groups are opened by `create_groups` below."""
+def init_state(n_replicas: int, n_groups: int, window: int,
+               shardings: "PaxosState | None" = None) -> PaxosState:
+    """All rows FREE; groups are opened by `create_groups` below.
+
+    ``shardings``: optional per-field sharding pytree (a ``PaxosState`` of
+    ``NamedSharding``, see ``parallel/mesh.state_shardings``).  When given,
+    every array is created ALREADY distributed across the mesh — at the
+    1M-group design point a single-device [R, W, G] state materializing
+    first and resharding after would double peak HBM on device 0.
+    """
+    if shardings is not None:
+        import jax
+
+        # jit with out_shardings: each device materializes only its own
+        # shard of the constant fill, never the full array.
+        return jax.jit(
+            lambda: init_state(n_replicas, n_groups, window),
+            out_shardings=shardings,
+        )()
     R, G, W = n_replicas, n_groups, window
 
     # Distinct buffers per field: the tick donates its input state, and XLA

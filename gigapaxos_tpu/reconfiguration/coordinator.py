@@ -298,6 +298,17 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
         # null-checkpoint disambiguation hazard, PaxosManager.java:383-390)
         pname = self._pax_name(name, epoch)
         with self.manager.lock:
+            # pipelined mode: the device can be one tick ahead of the host
+            # apps — the stop may have EXECUTED on device (is_stopped true,
+            # watermarks advanced) while the final decisions of the epoch
+            # sit in the undrained outbox.  Checkpointing the donor app now
+            # would ship a state missing those writes; the lock is
+            # re-entrant so the drain completes them here, atomically with
+            # the donor selection below.  (ChainManager has no pipeline —
+            # its ticks complete synchronously — hence the getattr.)
+            drain = getattr(self.manager, "drain_pipeline", None)
+            if drain is not None:
+                drain()
             if not self.manager.is_stopped(pname):
                 return None
             members = self.manager.group_members(pname)

@@ -93,6 +93,35 @@ def test_driver_stop_drains_pending():
         wal.close()
 
 
+def test_sync_due_tick_still_returns_outbox():
+    """A tick whose top-of-tick laggard sync drains the pipeline must hand
+    the drained outbox to the caller, not swallow it: callers polling
+    tick() (auto_sync_laggards consumers, the capacity probe) would
+    otherwise silently miss one tick's lag/decided signals on exactly the
+    ticks where repair happens.  Full-outbox mode, pipelined."""
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.pipeline_ticks = True
+    apps = [KVApp() for _ in range(3)]
+    m = PaxosManager(cfg, 3, apps)
+    m.create_paxos_instance("svc", [0, 1, 2])
+    for i in range(4):
+        m.propose("svc", f"PUT a{i} {i}".encode())
+    m.run_ticks(4)
+    # replica 2 falls more than a window behind, then revives: the next
+    # completion queues a sync, and the tick after that runs it
+    m.set_alive(2, False)
+    for i in range(30):
+        m.propose("svc", f"PUT k{i} {i}".encode())
+    m.run_ticks(12)
+    m.set_alive(2, True)
+    outs = [m.tick() for _ in range(8)]
+    assert m.stats["checkpoint_transfers"] >= 1
+    # pipeline was primed before the loop: every tick must return an
+    # outbox — including the sync-due ones that drained mid-tick
+    assert all(o is not None for o in outs), [o is None for o in outs]
+    assert apps[2].db["svc"] == apps[0].db["svc"]
+
+
 def test_modeb_pipelined_trio_commits():
     from gigapaxos_tpu.modeb import ModeBNode
     from gigapaxos_tpu.net.messenger import Messenger, NodeMap

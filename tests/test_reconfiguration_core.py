@@ -128,6 +128,59 @@ def test_coordinator_create_request_epoch_bump_and_final_state():
     assert coord.get_final_state("svc", 0) is None
 
 
+def test_get_final_state_serves_from_undrained_pipeline():
+    """Pipelined manager: the tick that decides the epoch stop leaves the
+    stop (and the epoch's final writes) in the pending outbox until the
+    NEXT tick completes it.  get_final_state must drain that pipeline under
+    the manager lock and serve the complete final state immediately — not
+    answer from the host's one-tick-stale view (None here; worse, a
+    checkpoint missing the final writes once watermarks and host state
+    skew).  Regression for the drain added to
+    reconfiguration/coordinator.py:get_final_state."""
+    import pytest as _pytest
+
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.pipeline_ticks = True
+    mgr = PaxosManager(cfg, 3, [KVApp() for _ in range(3)])
+    nodes = [f"AR{i}" for i in range(3)]
+    coord = PaxosReplicaCoordinator(mgr, nodes)
+    assert coord.create_replica_group("svc", 0, b"", nodes)
+    got = []
+    coord.coordinate_request("svc", 0, b"PUT k v0",
+                             lambda r, resp: got.append(resp))
+    mgr.run_ticks(4)
+    mgr.drain_pipeline()
+    assert got == [b"OK"]
+
+    # final write is device-decided (one tick), but its completion —
+    # execution + host bookkeeping — still sits in the pipeline when the
+    # stop goes in; a stop in the SAME inbox would win the slot race and
+    # fail the write instead
+    v1r = []
+    coord.coordinate_request("svc", 0, b"PUT k2 v1",
+                             lambda r, resp: v1r.append(resp))
+    mgr.tick()
+    done = []
+    assert coord.stop_replica_group("svc", 0, lambda ok: done.append(ok))
+    pname = "svc#0"
+    for _ in range(8):
+        mgr.tick()
+        if mgr._pending_out is not None and not mgr.is_stopped(pname):
+            # the decisive window: whatever this tick decided (eventually
+            # the stop) is still in the pending outbox.  Once the stop is
+            # device-decided, get_final_state must serve from HERE.
+            fs = coord.get_final_state("svc", 0)
+            if fs is not None:
+                break
+    else:
+        _pytest.fail("get_final_state never served while the stop sat in "
+                     "the undrained pipeline")
+    assert b"v1" in fs and b"v0" in fs
+    assert mgr.is_stopped(pname)  # the drain, not a later tick, completed it
+    assert v1r == [b"OK"]
+    assert done == [True]
+
+
 def test_final_state_never_served_empty_during_drop():
     """get_final_state racing drop_final_state must return the real final
     state or None — never found-with-EMPTY-bytes.  A drop that frees the

@@ -186,6 +186,11 @@ def run_bench() -> dict:
         "value": round(dps, 1),
         "unit": "decisions/s",
         "vs_baseline": round(dps / BASELINE_DECISIONS_PER_SEC, 2),
+        # dec/s = decisions_per_tick / ms_per_tick: published rounds have
+        # quoted all three inconsistently (PARITY.md reconciliation column),
+        # so every run now emits the factors next to the headline rate.
+        "decisions_per_tick": round(total_decisions / max(n_ticks, 1), 2),
+        "ms_per_tick": round(1e3 * dt / max(n_ticks, 1), 3),
     }
     if lat_p50 is not None:
         result["commit_latency_ms"] = {

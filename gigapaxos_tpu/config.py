@@ -158,6 +158,62 @@ class PaxosTuning:
 
 
 @dataclass
+class PlacementConfig:
+    """Placement plane: demand counters + shard rebalancer (placement/).
+
+    A mesh "shard" is a contiguous row range of the groups axis
+    (``G / groups_shards`` rows each, matching ``parallel/mesh.make_mesh``).
+    The placement plane folds per-group demand into EWMA rate counters,
+    detects hot/cold shards against ``skew_threshold``, and live-migrates
+    group rows between shard ranges through the stop/start epoch protocol
+    (placement/migrator.py).  All knobs mirror the demand SPI's rate-limit
+    shape (reconfiguration/demand.py ``min_interval_s`` /
+    ``min_requests_between``).
+    """
+
+    # Master switch: attach demand counters to the manager and (mesh +
+    # compact path) fold the per-group demand EWMA on device inside the
+    # compaction dispatch.
+    enabled: bool = False
+    # Per-tick EWMA decay of the per-group demand counter (device fold:
+    # demand' = decay * demand + decided_now).  0.9 ~ a
+    # ten-tick horizon; closer to 1.0 = smoother, slower to react.
+    ewma_decay: float = 0.9
+    # Host-fold sampling cadence: fold accumulated intake into the EWMA
+    # (and refresh shard loads) every this many ticks.
+    sample_every_ticks: int = 8
+    # Rebalance trigger: max/min shard-load ratio above which a plan is
+    # emitted (loads below ``min_shard_load`` count as idle floor, so an
+    # empty shard does not make the ratio infinite).
+    skew_threshold: float = 2.0
+    # Hysteresis: after a plan executes, shard loads must exceed the
+    # threshold by this factor before the NEXT plan (flap damping).
+    hysteresis: float = 1.25
+    # Rate limits, mirroring demand.py's _rate_limited guards.
+    min_interval_ticks: int = 64
+    min_moves_between: int = 0  # reserved: min demand delta between plans
+    # Per-plan cap on migrations (greedy bin-pack picks the hottest groups
+    # first; a huge plan would stall the tick loop on stop/start churn).
+    max_moves_per_plan: int = 4
+    # Idle floor for the skew ratio denominator (EWMA units).
+    min_shard_load: float = 1e-3
+
+    def __post_init__(self) -> None:
+        if not (0.0 < self.ewma_decay < 1.0):
+            raise ValueError(
+                f"placement.ewma_decay must be in (0, 1), got {self.ewma_decay}"
+            )
+        if self.skew_threshold < 1.0:
+            raise ValueError(
+                f"placement.skew_threshold must be >= 1, got {self.skew_threshold}"
+            )
+        if self.hysteresis < 1.0:
+            raise ValueError(
+                f"placement.hysteresis must be >= 1, got {self.hysteresis}"
+            )
+
+
+@dataclass
 class FailureDetectionConfig:
     """FailureDetection.java:63-76 analog (host-level, per node pair)."""
 
@@ -219,6 +275,7 @@ class NodeConfig:
 @dataclass
 class GigapaxosTpuConfig:
     paxos: PaxosTuning = field(default_factory=PaxosTuning)
+    placement: PlacementConfig = field(default_factory=PlacementConfig)
     fd: FailureDetectionConfig = field(default_factory=FailureDetectionConfig)
     ssl: SSLConfig = field(default_factory=SSLConfig)
     nodes: NodeConfig = field(default_factory=NodeConfig)
@@ -290,7 +347,7 @@ def load_properties(path: str) -> GigapaxosTpuConfig:
 
 def apply_env_overrides(cfg: GigapaxosTpuConfig) -> None:
     """Apply ``GPTPU_<SECTION>_<FIELD>`` environment overrides and re-validate."""
-    for sub_name in ("paxos", "fd", "ssl"):
+    for sub_name in ("paxos", "placement", "fd", "ssl"):
         sub = getattr(cfg, sub_name)
         for f_ in dataclasses.fields(sub):
             env = os.environ.get(f"GPTPU_{sub_name.upper()}_{f_.name.upper()}")
@@ -301,7 +358,7 @@ def apply_env_overrides(cfg: GigapaxosTpuConfig) -> None:
 
 def validate(cfg: GigapaxosTpuConfig) -> None:
     """Re-run dataclass validation (setattr bypasses ``__post_init__``)."""
-    for sub_name in ("paxos", "fd", "ssl"):
+    for sub_name in ("paxos", "placement", "fd", "ssl"):
         sub = getattr(cfg, sub_name)
         post = getattr(sub, "__post_init__", None)
         if post is not None:

@@ -170,7 +170,7 @@ def fetch_host_outbox(out: TickOutbox) -> "tk.HostOutbox":
 
 
 def make_shardmap_tick_compact(mesh: Mesh, own_row: int, exec_budget: int,
-                               lag_budget: int):
+                               lag_budget: int, demand_decay=None):
     """shard_map tick + budgeted on-device compaction (O(budget) transfer).
 
     The compaction stage runs global-view over the sharded outbox in its own
@@ -178,8 +178,41 @@ def make_shardmap_tick_compact(mesh: Mesh, own_row: int, exec_budget: int,
     executions across ALL groups, and the flat buffer layout
     (``CompactLayout``) stays identical to the single-device path so the
     manager's unpack/WAL/replay code needs no sharded variant.
+
+    ``demand_decay`` (placement plane): per-group ``decided_now`` [G] never
+    reaches the host in compact mode — only its sum survives the flat
+    buffer — so the demand EWMA fold ``d' = decay*d + decided_now`` must run
+    on device, and it must run in THIS dispatch: the compaction donates the
+    TickOutbox, so no later dispatch can read ``decided_now``.  With a decay
+    set, the returned callable takes and returns the [G] f32 demand array
+    (``P(groups)``-sharded, see :func:`init_demand`):
+    ``fn(state, inbox, demand) -> (state, flat, new_demand)``.
     """
     tick = make_shardmap_tick(mesh, own_row, exec_budget)
+    if demand_decay is None:
+        compact = jax.jit(
+            functools.partial(
+                tk._compact_outbox_impl,
+                exec_budget=exec_budget, lag_budget=lag_budget,
+            ),
+            donate_argnums=(0,),
+        )
+
+        def fn(state, inbox):
+            state, out = tick(state, inbox)
+            return state, compact(out)
+
+        return fn
+
+    decay = float(demand_decay)
+    # the fold is a SEPARATE dispatch from the compaction, not fused: adding
+    # the P(groups)-sharded demand operand/output to the compact jit changes
+    # the partitioner's sharding assignment and the flat buffer comes back
+    # with its counts multiplied by the groups-axis size (the same
+    # double-reduction failure the module docstring describes for same-jit
+    # fusion).  The fold is elementwise over two P(groups) arrays — no
+    # reductions for the partitioner to mangle — and it reads
+    # ``decided_now`` BEFORE the compact dispatch donates the outbox.
     compact = jax.jit(
         functools.partial(
             tk._compact_outbox_impl,
@@ -188,8 +221,26 @@ def make_shardmap_tick_compact(mesh: Mesh, own_row: int, exec_budget: int,
         donate_argnums=(0,),
     )
 
-    def fn(state, inbox):
-        state, out = tick(state, inbox)
-        return state, compact(out)
+    def _fold(decided_now, demand):
+        return decay * demand + decided_now.astype(demand.dtype)
 
-    return fn
+    fold = jax.jit(_fold, donate_argnums=(1,))
+
+    def fn3(state, inbox, demand):
+        state, out = tick(state, inbox)
+        new_demand = fold(out.decided_now, demand)
+        return state, compact(out), new_demand
+
+    return fn3
+
+
+def init_demand(mesh: Mesh, n_groups: int):
+    """Zeroed [G] f32 demand array, groups-sharded to match the fold."""
+    from jax.sharding import NamedSharding
+
+    import jax.numpy as jnp
+
+    return jax.device_put(
+        jnp.zeros(n_groups, jnp.float32),
+        NamedSharding(mesh, P(GROUPS_AXIS)),
+    )

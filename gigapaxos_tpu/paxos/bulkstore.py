@@ -202,6 +202,19 @@ class BulkStore:
         live = np.nonzero(self.valid)[0]
         return np.bincount(self.row[live], minlength=n_rows)
 
+    def live_by_shard(self, n_rows: int, groups_shards: int) -> np.ndarray:
+        """:meth:`live_by_row` reduced per mesh shard ``[groups_shards]``.
+
+        The placement plane's instantaneous intake-balance probe: shard k
+        owns the contiguous row range [k*per, (k+1)*per), so this is the
+        point-in-time twin of the EWMA shard loads in
+        ``placement/counters.py`` (which smooth the same signal over
+        ticks)."""
+        per_row = self.live_by_row(n_rows)
+        return per_row.reshape(
+            groups_shards, n_rows // groups_shards
+        ).sum(axis=1)
+
     # -------------------------------------------------------------- snapshot
     def snapshot(self) -> dict:
         """Dense snapshot of live entries only (for WAL checkpoints)."""

@@ -230,7 +230,9 @@ class PaxosManager:
             _stk.validate_mesh_for(self.mesh, self.R, self.G)
             if self._use_compact:
                 self._mesh_tick_compact = _stk.make_shardmap_tick_compact(
-                    self.mesh, -1, self._exec_budget, self._lag_budget
+                    self.mesh, -1, self._exec_budget, self._lag_budget,
+                    demand_decay=(cfg.placement.ewma_decay
+                                  if cfg.placement.enabled else None),
                 )
             else:
                 self._mesh_tick = _stk.make_shardmap_tick(self.mesh, -1)
@@ -240,6 +242,28 @@ class PaxosManager:
                 self.R, self.G, self.W,
                 shardings=state_shardings(self.mesh),
             )
+        # ---- placement plane (placement/): advisory demand counters ----
+        # Excluded from WAL/snapshot on purpose: a recovered node restarts
+        # with cold counters and waits out the rebalancer's min-interval
+        # guard; the migrations themselves ARE journaled (OP_CREATE_AT).
+        self._placement = None
+        self._demand_dev = None
+        if cfg.placement.enabled:
+            from ..parallel.mesh import GROUPS_AXIS as _GAX
+            from ..placement.counters import PlacementCounters
+
+            gs = self.mesh.shape[_GAX] if self.mesh is not None else 1
+            self._placement = PlacementCounters(
+                self.G, gs,
+                decay=cfg.placement.ewma_decay,
+                sample_every_ticks=cfg.placement.sample_every_ticks,
+            )
+            if self._mesh_tick_compact is not None:
+                # device fold active: the tick threads this array through
+                # the compact dispatch (see make_shardmap_tick_compact)
+                from ..parallel import shard_tick as _stk2
+
+                self._demand_dev = _stk2.init_demand(self.mesh, self.G)
         # first-occurrence scratch (generation-tagged so no per-tick clear)
         self._scr_pos = np.zeros(self.R * self.G, np.int64)
         self._scr_gen = np.zeros(self.R * self.G, np.int64)
@@ -302,6 +326,48 @@ class PaxosManager:
         self._last_active[row] = self.tick_num
         if self.wal is not None:
             self.wal.log_create(name, members, epoch)
+        return True
+
+    @_locked
+    def create_paxos_instance_at(
+        self, name: str, members: List[int], epoch: int, row: int,
+        app_seed: Optional[bytes] = None,
+    ) -> bool:
+        """Targeted create at a SPECIFIC free row (placement migration:
+        the destination row selects the destination mesh shard).
+
+        Unlike :meth:`create_paxos_instance` this never evicts — a full
+        destination shard is a planning failure, not an excuse to spill
+        someone else's group.  ``app_seed`` (the migrated epoch's final
+        checkpoint) is restored into every member's app UNDER THE SAME
+        LOCK as the birth and journaled WITH the create (OP_CREATE_AT):
+        the plain create path's seed is applied by the caller and never
+        journaled, which is fine for empty births but would lose a
+        migrated group's state on replay."""
+        if name in self.rows or name in self._paused:
+            return False
+        try:
+            self.rows.alloc_at(name, row)
+        except KeyError:
+            return False  # row occupied / out of range
+        mask = np.zeros((1, self.R), bool)
+        for m in members:
+            mask[0, m] = True
+        self.state = st.create_groups(
+            self.state,
+            np.array([row], np.int32),
+            mask,
+            np.array([epoch], np.int32),
+        )
+        self._set_member_row(row, mask[0], name)
+        self._stopped_rows.discard(row)
+        self._stopped_np[row] = False
+        self._last_active[row] = self.tick_num
+        if app_seed is not None:
+            for s in members:
+                self.apps[s].restore(name, app_seed)
+        if self.wal is not None:
+            self.wal.log_create_at(name, list(members), epoch, row, app_seed)
         return True
 
     def create_paxos_instances(
@@ -435,6 +501,35 @@ class PaxosManager:
         if row is None:
             return None
         return np.array(self.state.exec_slot[:, row])
+
+    # ---------------------------------------------------------- placement
+    def shard_geometry(self) -> tuple:
+        """(groups_shards, rows_per_shard): mesh shard k owns the
+        contiguous row range [k*per, (k+1)*per)."""
+        gs = 1
+        if self.mesh is not None:
+            from ..parallel.mesh import GROUPS_AXIS as _GAX
+
+            gs = self.mesh.shape[_GAX]
+        return gs, self.G // gs
+
+    @_locked
+    def free_rows_in_shard(self, shard: int) -> int:
+        """Free-row capacity of one mesh shard (rebalancer's budget)."""
+        gs, _per = self.shard_geometry()
+        lo, hi = st.shard_row_range(self.G, gs, shard)
+        return sum(1 for r in self.rows._free if lo <= r < hi)
+
+    def demand_snapshot(self):
+        """Host view of the per-group demand EWMA [G] (None when the
+        placement plane is disabled).  Device-folded demand is pulled at
+        most every ``placement.sample_every_ticks`` ticks."""
+        p = self._placement
+        if p is None:
+            return None
+        if self._demand_dev is not None and p.should_sample():
+            p.sample_device()  # one device->host pull per sample window
+        return p.demand_snapshot()
 
     # ------------------------------------------------------------ pause/spill
     def _resident_row(self, name: str) -> Optional[int]:
@@ -1301,7 +1396,16 @@ class PaxosManager:
         elif self._mesh_tick_compact is not None:
             # numpy inbox: committed to the mesh layout by in_shardings on
             # entry, as is the state after any eager admin-op mutation
-            self.state, packed = self._mesh_tick_compact(self.state, inbox)
+            if self._demand_dev is not None:
+                # placement: the demand EWMA folds inside the compact
+                # dispatch (decided_now is donated away otherwise)
+                self.state, packed, self._demand_dev = (
+                    self._mesh_tick_compact(self.state, inbox,
+                                            self._demand_dev)
+                )
+                self._placement.adopt_device(self._demand_dev)
+            else:
+                self.state, packed = self._mesh_tick_compact(self.state, inbox)
         elif self._mesh_tick is not None:
             self.state, packed = self._mesh_tick(self.state, inbox)
         elif self._use_compact:
@@ -1440,6 +1544,10 @@ class PaxosManager:
                    np.asarray(out.exec_base) + np.asarray(out.exec_count),
                    out=self._host_exec)
         self.stats["decisions"] += int(out.decided_now.sum())
+        if self._placement is not None and self._demand_dev is None:
+            # host demand fold (full-outbox path): per-group decisions are
+            # visible here, unlike the compact flat buffer
+            self._placement.observe_intake(np.asarray(out.decided_now))
         # Self-heal laggards in FULL-outbox mode too (the compact path has
         # the twin block in _process_compact): a replica >= W behind can
         # never catch up by ring sync — its missed slots rotated out of
@@ -1667,6 +1775,16 @@ class PaxosManager:
                 ti = np.concatenate(touched)
                 store.free_done(ti, self._member_bits[store.row[ti]])
         self.stats["decisions"] += co.decided_total
+        if self._placement is not None and self._demand_dev is None:
+            # host demand fold (single-device compact path): per-group
+            # decisions are gone from the flat buffer, so fold the intake
+            # acceptance bits instead — popcount of each row's taken mask
+            bits = co.taken_bits.astype(np.int64)
+            per_row = np.zeros(self.G, np.int64)
+            for _ in range(self.P):
+                per_row += (bits & 1).sum(axis=0)
+                bits >>= 1
+            self._placement.observe_intake(per_row)
         self._lag_pending = (co.l_rep.copy(), co.l_row.copy())
         # During journal replay (_replay_process installed) laggard repair
         # must come ONLY from journaled OP_SYNC records: the live run's

@@ -260,6 +260,25 @@ def free_groups(state: PaxosState, rows: np.ndarray) -> PaxosState:
     )
 
 
+# ----------------------------------------------------------- shard geometry
+#
+# A groups-axis mesh shard owns a CONTIGUOUS row range of the [G] arrays
+# (parallel/mesh.py shards the minor axis in equal blocks).  The placement
+# plane's "migrate a group between shards" is therefore "re-home its name to
+# a row in a different range"; this is the one place that geometry is
+# written down.
+
+def shard_row_range(n_groups: int, groups_shards: int, shard: int) -> tuple:
+    """Row range ``[lo, hi)`` owned by mesh shard ``shard``."""
+    per = n_groups // groups_shards
+    return shard * per, (shard + 1) * per
+
+
+def shard_of_row(n_groups: int, groups_shards: int, row: int) -> int:
+    """Which mesh shard owns ``row``."""
+    return int(row) // (n_groups // groups_shards)
+
+
 # --------------------------------------------------------------- pause/spill
 #
 # The reference proves a paused group's resident state is ~9 scalars

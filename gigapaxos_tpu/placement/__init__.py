@@ -1,0 +1,39 @@
+"""Placement plane: demand-driven live migration of Paxos groups.
+
+PR-1 sharded the data plane (parallel/shard_tick.py) but left nothing
+balancing it: a hot groups-axis shard caps the whole mesh while cold shards
+idle ("The Performance of Paxos in the Cloud" shape of collapse).  This
+package closes the control loop:
+
+* :mod:`counters`   — per-group demand as EWMA request-rate counters, folded
+  on device inside the compaction dispatch (mesh path) or from host intake
+  bookkeeping (everywhere else), reduced per shard;
+* :mod:`rebalancer` — host-side hot/cold shard detection + greedy bin-pack
+  migration plans, with hysteresis and min-interval guards mirroring the
+  demand SPI's rate limits (reconfiguration/demand.py);
+* :mod:`migrator`   — live row migration between shard ranges through the
+  stop/start epoch protocol (reconfiguration/coordinator.py), journaled for
+  deterministic WAL replay;
+* :mod:`table`      — an explicit placement-override table layered over the
+  consistent-hash ring, consulted by edge routing and serializable through
+  the replicated reconfigurator DB (rc_db.py).
+
+The decision plane runs host-side off dense device counters (the HT-Paxos
+separation of load shedding from the consensus hot path); the data plane
+never waits on it.
+"""
+
+from .counters import PlacementCounters
+from .migrator import GroupMigrator, MigrationStats
+from .rebalancer import MigrationPlan, ShardRebalancer
+from .table import PLACEMENT_RECORD, PlacementTable
+
+__all__ = [
+    "PlacementCounters",
+    "GroupMigrator",
+    "MigrationStats",
+    "MigrationPlan",
+    "ShardRebalancer",
+    "PlacementTable",
+    "PLACEMENT_RECORD",
+]

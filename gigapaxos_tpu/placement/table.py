@@ -1,0 +1,143 @@
+"""Placement-override table: explicit exceptions over the hash ring.
+
+The consistent-hash ring (reconfiguration/consistent_hashing.py) is the
+*default* placement function — any node can compute a name's servers with no
+directory.  Demand-driven migration breaks that purity: a migrated name
+lives where the rebalancer put it, not where it hashes.  This table is the
+directory for exactly those exceptions: lookups fall through to the ring
+when no override exists, so the table stays O(migrated names), not O(names).
+
+Durability rides the replicated reconfigurator DB (rc_db.py): overrides
+serialize into the special ``_PLACEMENT`` record's ``rc_epochs`` field — the
+record's generic str->int map — via ``placement_set`` / ``placement_clear``
+commands, so every RC replica derives the identical table from the committed
+command stream and it survives checkpoint/restore like any other record.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from ..reconfiguration.consistent_hashing import ConsistentHashRing
+
+#: the special rc_db record that carries the override map (one per plane,
+#: replicated on every reconfigurator like the NC records)
+PLACEMENT_RECORD = "_PLACEMENT"
+
+
+class PlacementTable:
+    """name -> destination shard overrides, layered over a hash ring.
+
+    ``shard_of(name)`` is the routing function the edges consult: the
+    override when one exists, else the ring default.  For server-list
+    routing (``lookup``), an override reorders the ring's replica set so
+    the overridden shard's server leads — traffic converges to the new
+    placement while the full replica set stays reachable.
+    """
+
+    def __init__(self, ring: ConsistentHashRing,
+                 shard_of_server: Optional[Dict[str, int]] = None):
+        self.ring = ring
+        #: server id -> shard index (identity layout: server i owns shard i);
+        #: deployments with a different mapping pass their own.
+        self.shard_of_server = shard_of_server or {
+            s: i for i, s in enumerate(ring.nodes)
+        }
+        self._server_of_shard = {v: k for k, v in self.shard_of_server.items()}
+        self.overrides: Dict[str, int] = {}
+
+    # ------------------------------------------------------------- overrides
+    def set_override(self, name: str, shard: int) -> None:
+        self.overrides[name] = int(shard)
+
+    def clear_override(self, name: str) -> None:
+        self.overrides.pop(name, None)
+
+    def default_shard(self, name: str) -> int:
+        primary = self.ring.primary(name)
+        return self.shard_of_server.get(primary, 0)
+
+    def shard_of(self, name: str) -> int:
+        ov = self.overrides.get(name)
+        return self.default_shard(name) if ov is None else ov
+
+    # --------------------------------------------------------------- routing
+    def lookup(self, name: str, k: int = 3) -> List[str]:
+        """The k servers for ``name``: the ring's answer verbatim when no
+        override exists; with one, the override shard's server is promoted
+        to the front (clients hit the new home first, the rest of the ring
+        set stays as fallback)."""
+        servers = self.ring.replicated_servers(name, k)
+        ov = self.overrides.get(name)
+        if ov is None:
+            return servers
+        lead = self._server_of_shard.get(ov)
+        if lead is None:
+            return servers
+        return [lead] + [s for s in servers if s != lead][: max(k - 1, 0)]
+
+    def order_actives(self, name: str, actives: Sequence[str]) -> List[str]:
+        """Reorder an arbitrary server list so an overridden name's new
+        home leads (edge routing: DNS answer order / REQ_ACTIVES order).
+        No override, or the override's server absent: verbatim."""
+        ov = self.overrides.get(name)
+        if ov is None:
+            return list(actives)
+        lead = self._server_of_shard.get(ov)
+        if lead is None or lead not in actives:
+            return list(actives)
+        return [lead] + [a for a in actives if a != lead]
+
+    # ------------------------------------------------------ rc_db integration
+    def to_command(self, name: str) -> dict:
+        """The committed command installing ``name``'s current override
+        (``placement_clear`` when none)."""
+        ov = self.overrides.get(name)
+        if ov is None:
+            return {"op": "placement_clear", "name": PLACEMENT_RECORD,
+                    "service": name}
+        return {"op": "placement_set", "name": PLACEMENT_RECORD,
+                "service": name, "shard": ov}
+
+    def load_record(self, record_dict: Optional[dict]) -> None:
+        """Adopt the override map from a ``_PLACEMENT`` record dict (as
+        produced by ``ReconfigurationRecord.to_dict`` after rc_db applied
+        placement commands); None/missing clears."""
+        self.overrides = {
+            str(n): int(s)
+            for n, s in (record_dict or {}).get("rc_epochs", {}).items()
+        }
+
+    def splice(self, ring: ConsistentHashRing,
+               shard_of_server: Optional[Dict[str, int]] = None) -> None:
+        """Adopt a new ring (node add/remove) keeping the overrides: an
+        override pins a name regardless of where the new ring hashes it."""
+        self.ring = ring
+        self.shard_of_server = shard_of_server or {
+            s: i for i, s in enumerate(ring.nodes)
+        }
+        self._server_of_shard = {v: k for k, v in self.shard_of_server.items()}
+
+
+def apply_placement_command(records: dict, cmd: dict, make_record) -> dict:
+    """rc_db apply-helper for ``placement_set`` / ``placement_clear``.
+
+    Lives here (not in rc_db) so the table format has one home; rc_db calls
+    it from its deterministic ``_apply``.  ``records`` is the DB's record
+    map, ``make_record`` builds a fresh ReconfigurationRecord.  The override
+    map rides the ``_PLACEMENT`` record's ``rc_epochs`` (its generic
+    str->int field), so checkpoint/restore and record_install carry it with
+    zero record-schema changes.
+    """
+    rec = records.get(PLACEMENT_RECORD)
+    if rec is None:
+        rec = records[PLACEMENT_RECORD] = make_record(PLACEMENT_RECORD)
+    service = cmd.get("service", "")
+    if not service:
+        return {"ok": False, "error": "no_service"}
+    if cmd["op"] == "placement_set":
+        rec.rc_epochs[service] = int(cmd["shard"])
+    else:
+        rec.rc_epochs.pop(service, None)
+    rec.epoch += 1  # version counter, mirrors the NC records
+    return {"ok": True, "overrides": dict(rec.rc_epochs), "epoch": rec.epoch}

@@ -252,6 +252,31 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
             self._epoch[name] = epoch
         return True
 
+    def create_replica_group_at(
+        self, name: str, epoch: int, initial_state: bytes, nodes: List[str],
+        row: int,
+    ) -> bool:
+        """Targeted-row twin of :meth:`create_replica_group` (placement
+        migration: the row selects the destination mesh shard).  The seed
+        rides the manager's journaled targeted create (OP_CREATE_AT) —
+        unlike the plain path's caller-side restore, a migrated epoch's
+        blob must survive WAL replay because the source epoch's copy is
+        dropped right after."""
+        slots = [self._slot[n] for n in nodes if n in self._slot]
+        if not slots:
+            return False
+        pname = self._pax_name(name, epoch)
+        with self.manager.lock:
+            ok = self.manager.create_paxos_instance_at(
+                pname, slots, epoch, row, app_seed=initial_state
+            )
+        if not ok:
+            return False
+        live = self._epoch.get(name)
+        if live is None or epoch > live:
+            self._epoch[name] = epoch
+        return True
+
     def delete_replica_group(self, name: str, epoch: int) -> bool:
         pname = self._pax_name(name, epoch)
         ok = self.manager.remove_paxos_instance(pname)

@@ -33,6 +33,22 @@ def default_policy(name: str, actives: List[str], addrs: dict) -> List[str]:
     return ips[k:] + ips[:k]
 
 
+def placement_policy(table, base: DnsTrafficPolicy = default_policy
+                     ) -> DnsTrafficPolicy:
+    """Traffic policy consulting the placement-override table
+    (placement/table.py): a migrated name's answer leads with its override
+    shard's server, so clients converge to the new placement within one
+    TTL; un-overridden names fall through to ``base`` untouched."""
+
+    def policy(name: str, actives: List[str], addrs: dict) -> List[str]:
+        ordered = table.order_actives(name, actives)
+        if ordered == list(actives):
+            return base(name, actives, addrs)
+        return [addrs[a][0] for a in ordered if a in addrs]
+
+    return policy
+
+
 class DnsReconfigurator:
     def __init__(
         self,

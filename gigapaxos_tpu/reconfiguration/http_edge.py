@@ -94,7 +94,16 @@ class _Edge:
 
 
 class HttpReconfigurator(_Edge):
-    """Name management over HTTP (HttpReconfigurator.java:79)."""
+    """Name management over HTTP (HttpReconfigurator.java:79).
+
+    ``placement_table`` (placement/table.py, optional): REQ_ACTIVES answers
+    are reordered so a migrated name's new home leads — the HTTP twin of
+    the DNS edge's ``placement_policy``."""
+
+    def __init__(self, client: ReconfigurableAppClient,
+                 bind: Tuple[str, int], placement_table=None):
+        self.placement = placement_table
+        super().__init__(client, bind)
 
     def handle(self, h: BaseHTTPRequestHandler) -> None:
         p = _params(h)
@@ -117,6 +126,8 @@ class HttpReconfigurator(_Edge):
                         "ERROR": r.get("error")})
             else:  # REQ_ACTIVES
                 actives = self.client.request_actives(name)
+                if self.placement is not None:
+                    actives = self.placement.order_actives(name, actives)
                 _reply(h, 200, {"NAME": name, "ACTIVES": actives})
         except ClientError as e:
             _reply(h, 404, {"NAME": name, "FAILED": True, "ERROR": str(e)})

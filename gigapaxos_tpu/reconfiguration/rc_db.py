@@ -203,6 +203,21 @@ class ReconfiguratorDB(Replicable):
             rec.epoch += 1  # NC epoch counts config versions
             return {"ok": True, "pool": rec.actives, "epoch": rec.epoch,
                     "universe": list(rec.universe)}
+        if op in ("placement_set", "placement_clear"):
+            # placement-override table (placement/table.py): overrides ride
+            # the special _PLACEMENT record's rc_epochs map, so they are
+            # replicated/checkpointed like every other record.  Import is
+            # deferred: reconfiguration.__init__ imports this module, and
+            # placement.table imports consistent_hashing back from it.
+            from ..placement.table import (PLACEMENT_RECORD,
+                                           apply_placement_command)
+
+            if name != PLACEMENT_RECORD:
+                return {"ok": False, "error": "placement_record_only"}
+            return apply_placement_command(
+                self.records, cmd,
+                lambda n: ReconfigurationRecord(name=n),
+            )
         if op == "create":
             if rec is not None:
                 return {"ok": False, "error": "exists", "epoch": rec.epoch}

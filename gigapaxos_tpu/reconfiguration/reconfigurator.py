@@ -289,6 +289,10 @@ class Reconfigurator:
         self._sub_batches: Dict[int, list] = {}
         self._sub_done: Dict[int, Callable[[dict], None]] = {}
         self._sub_next = 1 << 41  # disjoint from client and anycast rids
+        #: optional placement-override table (placement/table.py): when the
+        #: placement plane is live, overrides take precedence over the ring
+        #: for name placement (set by the deployment wiring, not here)
+        self.placement_table = None
         self.executor = ProtocolExecutor(self.m.send, name=f"rc-{node_id}")
         for ptype, h in [
             (pkt.CREATE_SERVICE_NAME, self._on_create),
@@ -315,10 +319,14 @@ class Reconfigurator:
     # ------------------------------------------------------------- placement
     def initial_actives(self, name: str) -> List[str]:
         """Default placement: consistent-hash the name onto the active pool
-        (ReconfigurationConfig's default placement policy)."""
-        return self.actives_ring.replicated_servers(
-            name, min(self.k, len(self.actives_pool))
-        )
+        (ReconfigurationConfig's default placement policy).  With a
+        placement-override table attached, an overridden name's servers
+        come from the table instead (lookup falls through to the same ring
+        when no override exists)."""
+        k = min(self.k, len(self.actives_pool))
+        if self.placement_table is not None:
+            return self.placement_table.lookup(name, k)
+        return self.actives_ring.replicated_servers(name, k)
 
     def _ensure_owner(self, name: str, sender: str, p: dict) -> bool:
         """With more reconfigurators than k, a client packet may land on an

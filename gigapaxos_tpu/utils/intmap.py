@@ -45,6 +45,33 @@ class RowAllocator:
         self._row_to_name[row] = name
         return row
 
+    def alloc_at(self, name: str, row: int) -> int:
+        """Allocate a SPECIFIC free row (shard-targeted placement).
+
+        O(free) list removal — migrations are control-plane-rare.  The
+        caller journals the row (WAL OP_CREATE_AT), so replay repeats the
+        identical targeted pop and the free-list order stays in lockstep
+        with the live run for every subsequent LIFO ``alloc``.
+        """
+        if name in self._name_to_row:
+            raise KeyError(f"{name!r} already allocated")
+        try:
+            self._free.remove(row)
+        except ValueError:
+            raise KeyError(f"row {row} is not free") from None
+        self._name_to_row[name] = row
+        self._row_to_name[row] = name
+        return row
+
+    def free_in_range(self, lo: int, hi: int) -> Optional[int]:
+        """Most-recently-freed free row in ``[lo, hi)`` (LIFO top first), or
+        None.  Deterministic given the free-list content, so a journaled
+        replay that re-runs the same search picks the same row."""
+        for r in reversed(self._free):
+            if lo <= r < hi:
+                return r
+        return None
+
     def row(self, name: str) -> Optional[int]:
         return self._name_to_row.get(name)
 

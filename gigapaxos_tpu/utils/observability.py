@@ -92,3 +92,34 @@ def transport_stats_source(transport) -> Callable[[], dict]:
         return dict(transport.stats)
 
     return snap
+
+
+def migration_stats_source(migrator) -> Callable[[], dict]:
+    """Placement-plane counters: plans emitted, groups moved, bytes
+    transferred, abort/retry counts (placement/migrator.MigrationStats)."""
+
+    def snap() -> dict:
+        return migrator.stats.snapshot()
+
+    return snap
+
+
+def shard_load_source(manager) -> Callable[[], dict]:
+    """Per-shard load gauge off the placement demand counters: the EWMA
+    demand summed over each mesh shard's row range, plus the max/min skew
+    ratio the rebalancer triggers on."""
+
+    def snap() -> dict:
+        p = getattr(manager, "_placement", None)
+        if p is None:
+            return {"enabled": False}
+        manager.demand_snapshot()  # refresh host mirror (sample-gated)
+        loads = p.shard_loads()
+        lo = max(float(loads.min()), 1e-9)
+        return {
+            "enabled": True,
+            "shard_loads": [round(float(x), 3) for x in loads],
+            "skew": round(float(loads.max()) / lo, 3),
+        }
+
+    return snap

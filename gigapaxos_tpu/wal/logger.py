@@ -43,6 +43,9 @@ OP_PAUSE = 4
 OP_UNPAUSE = 5
 OP_SYNC = 6  # checkpoint transfer (laggard repair) — state change outside
              # the tick stream, so replay must re-apply it in sequence
+OP_CREATE_AT = 7  # targeted create (placement migration): carries the row
+                  # AND the app seed blob — the migrated epoch's state
+                  # exists nowhere else once the source epoch is dropped
 
 
 def _new_journal(path: str, native_ok: bool):
@@ -103,6 +106,18 @@ class PaxosLogger:
             self.journal.append(
                 records.dumps((OP_CREATE, name, list(members), epoch))
             )
+        self.journal.sync()
+
+    def log_create_at(self, name: str, members: List[int], epoch: int,
+                      row: int, app_seed) -> None:
+        """Targeted create (placement migration).  Journals the destination
+        row — replay must repeat the identical targeted allocation to keep
+        the free-list in lockstep — and the app seed blob, which for a
+        migrated group is the ONLY durable copy of its pre-move history
+        once the source epoch's row is removed."""
+        self.journal.append(records.dumps(
+            (OP_CREATE_AT, name, members, epoch, row, app_seed)
+        ))
         self.journal.sync()
 
     def log_remove(self, name: str) -> None:
@@ -333,6 +348,14 @@ def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
                 _, name, members, epoch = rec
                 if name not in m.rows:
                     m.create_paxos_instance(name, members, epoch)
+            elif op == OP_CREATE_AT:
+                _, name, members, epoch, row, app_seed = rec
+                if name not in m.rows:
+                    # targeted create + app re-seed: replay lands the
+                    # migrated group on the SAME row with the SAME state
+                    m.create_paxos_instance_at(
+                        name, members, epoch, row, app_seed=app_seed
+                    )
             elif op == OP_REMOVE:
                 m.remove_paxos_instance(rec[1])
             elif op == OP_PAUSE:

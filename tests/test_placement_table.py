@@ -1,0 +1,190 @@
+"""Unit tests for the placement plane's host pieces: override table (and
+its rc_db serialization), demand counters, rebalancer guards, and the O(1)
+batched demand-profile fold."""
+
+import json
+
+import numpy as np
+
+from gigapaxos_tpu.placement import (
+    PLACEMENT_RECORD,
+    PlacementCounters,
+    PlacementTable,
+    ShardRebalancer,
+)
+from gigapaxos_tpu.reconfiguration.consistent_hashing import ConsistentHashRing
+from gigapaxos_tpu.reconfiguration.demand import DemandProfile
+from gigapaxos_tpu.reconfiguration.rc_db import ReconfiguratorDB
+
+SERVERS = [f"s{i}" for i in range(8)]
+
+
+def make_table():
+    return PlacementTable(ConsistentHashRing(SERVERS))
+
+
+# ------------------------------------------------------------------- table
+def test_table_agrees_with_ring_absent_overrides():
+    t = make_table()
+    ring = ConsistentHashRing(SERVERS)
+    for i in range(50):
+        name = f"name{i}"
+        assert t.lookup(name, 3) == ring.replicated_servers(name, 3)
+        assert t.shard_of(name) == t.default_shard(name)
+        acts = ring.replicated_servers(name, 4)
+        assert t.order_actives(name, acts) == acts
+
+
+def test_table_override_promotes_and_clears():
+    t = make_table()
+    t.set_override("alice", 5)
+    assert t.shard_of("alice") == 5
+    assert t.lookup("alice", 3)[0] == "s5"
+    assert len(t.lookup("alice", 3)) == 3
+    assert t.order_actives("alice", SERVERS)[0] == "s5"
+    # the rest of the set is the ring's, order preserved
+    rest = [s for s in t.order_actives("alice", SERVERS) if s != "s5"]
+    assert rest == [s for s in SERVERS if s != "s5"]
+    # override's server missing from the list -> verbatim
+    assert t.order_actives("alice", ["s1", "s2"]) == ["s1", "s2"]
+    t.clear_override("alice")
+    ring = ConsistentHashRing(SERVERS)
+    assert t.lookup("alice", 3) == ring.replicated_servers("alice", 3)
+
+
+def test_table_survives_ring_splice():
+    t = make_table()
+    t.set_override("alice", 3)
+    t.splice(ConsistentHashRing(SERVERS + ["s8"]))
+    assert t.shard_of("alice") == 3  # override pins through node add
+    assert t.lookup("alice", 3)[0] == "s3"
+
+
+def test_table_serializes_through_rc_db():
+    """placement_set/clear commands apply deterministically in rc_db, ride
+    the _PLACEMENT record through checkpoint/restore, and load back into a
+    fresh table."""
+    db = ReconfiguratorDB("X")
+
+    def run(cmd):
+        return json.loads(db.execute(
+            PLACEMENT_RECORD, json.dumps(cmd).encode(), 0).decode())
+
+    t = make_table()
+    t.set_override("alice", 2)
+    r = run(t.to_command("alice"))
+    assert r["ok"] and r["overrides"] == {"alice": 2}
+    t.set_override("bob", 7)
+    assert run(t.to_command("bob"))["overrides"] == {"alice": 2, "bob": 7}
+
+    # checkpoint -> wipe -> restore: overrides come back
+    ck = db.checkpoint("_RC:any")
+    db.restore("_RC:any", b"")
+    assert db.get(PLACEMENT_RECORD) is None
+    db.restore("_RC:any", ck)
+    t2 = make_table()
+    t2.load_record(db.get(PLACEMENT_RECORD).to_dict())
+    assert t2.overrides == {"alice": 2, "bob": 7}
+    assert t2.lookup("bob", 3)[0] == "s7"
+
+    # clear replicates too
+    t2.clear_override("alice")
+    assert run(t2.to_command("alice"))["overrides"] == {"bob": 7}
+    # placement ops are rejected on any other record name
+    bad = json.loads(db.execute("other", json.dumps(
+        {"op": "placement_set", "name": "other", "service": "x",
+         "shard": 1}).encode(), 0).decode())
+    assert not bad["ok"]
+
+
+# ---------------------------------------------------------------- counters
+def test_counters_ewma_and_shard_loads():
+    c = PlacementCounters(16, 4, decay=0.5)
+    per = np.zeros(16)
+    per[0] = 8  # shard 0 hot
+    c.observe_intake(per)
+    c.observe_intake(per)
+    assert np.isclose(c.demand[0], 8 * 0.5 + 8)
+    loads = c.shard_loads()
+    assert loads[0] > 0 and np.all(loads[1:] == 0)
+    assert c.shard_of_row(0) == 0 and c.shard_of_row(15) == 3
+    assert c.shard_range(2) == (8, 12)
+    c.move_row(0, 9)
+    assert c.demand[0] == 0 and c.shard_loads()[2] > 0
+
+
+# -------------------------------------------------------------- rebalancer
+def flat_free(_shard):
+    return 4
+
+
+def test_rebalancer_quiet_below_threshold():
+    reb = ShardRebalancer(16, 4, skew_threshold=3.0, min_interval_ticks=0)
+    demand = np.ones(16)  # perfectly balanced
+    assert not reb.propose(0, demand, flat_free)
+
+
+def test_rebalancer_moves_hottest_group_and_respects_capacity():
+    reb = ShardRebalancer(16, 4, skew_threshold=2.0, min_interval_ticks=0,
+                          max_moves_per_plan=4)
+    demand = np.ones(16)
+    demand[0:4] = 10.0  # shard 0 carries 40 vs 4 on the others
+    plan = reb.propose(0, demand, flat_free)
+    assert plan and all(src == 0 for _, src, _ in plan.moves)
+    assert plan.moves[0][0] in range(4)  # a shard-0 row, hottest first
+    assert plan.skew_predicted < plan.skew_before
+    # the overshoot guard stops before the plan inverts the imbalance
+    assert len(plan.moves) < 4
+    # a destination with no free rows is skipped entirely
+    reb2 = ShardRebalancer(16, 4, skew_threshold=2.0, min_interval_ticks=0)
+    plan2 = reb2.propose(0, demand, lambda s: 0)
+    assert not plan2
+
+
+def test_rebalancer_hysteresis_and_min_interval():
+    reb = ShardRebalancer(16, 4, skew_threshold=2.0, hysteresis=1.25,
+                          min_interval_ticks=10)
+    demand = np.ones(16)
+    demand[0] = 40.0
+    assert reb.propose(0, demand, flat_free)
+    # immediately after a plan: disarmed AND rate-limited
+    assert not reb.propose(1, demand, flat_free)
+    # interval elapsed but still disarmed (skew never fell below
+    # threshold/hysteresis since the last plan)
+    assert not reb.propose(20, demand, flat_free)
+    # skew drops below the re-arm point...
+    assert not reb.propose(21, np.ones(16), flat_free)
+    # ...so a NEW hot spot triggers again
+    demand2 = np.ones(16)
+    demand2[5] = 40.0
+    assert reb.propose(22, demand2, flat_free)
+    # an aborted execution re-arms without waiting for the skew dip
+    assert not reb.propose(23, demand2, flat_free)
+    reb.record_aborted()
+    assert reb.propose(40, demand2, flat_free)
+    # executed moves re-arm too (distribution changed; only min_interval
+    # paces the follow-up), while an un-executed plan stays disarmed
+    assert not reb.propose(41, demand2, flat_free)  # disarmed again
+    reb.record_executed(1)
+    assert reb.propose(55, demand2, flat_free)
+
+
+# ---------------------------------------------- demand profile batched fold
+def test_register_requests_batch_matches_loop():
+    """The O(1) batch fold advances the same counters as n single calls and
+    lands the same EWMA when the n arrivals are evenly spaced."""
+    a = DemandProfile("svc", min_requests_before_report=10 ** 9)
+    b = DemandProfile("svc", min_requests_before_report=10 ** 9)
+    t = 100.0
+    a.register_request("c1", now=t)
+    b.register_request("c1", now=t)
+    # 5 arrivals over [t, t+1], evenly spaced 0.2 apart
+    for i in range(1, 6):
+        a.register_request("c1", now=t + 0.2 * i)
+    b.register_requests("c1", 5, now=t + 1.0)
+    assert a.num_total == b.num_total == 6
+    assert a.by_sender == b.by_sender
+    assert np.isclose(a.inter_arrival_ewma, b.inter_arrival_ewma, rtol=1e-6)
+    # degenerate inputs
+    b.register_requests("c1", 0, now=t + 2.0)
+    assert b.num_total == 6

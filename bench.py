@@ -20,7 +20,8 @@ GPTPU_BENCH_REPLICAS (3), GPTPU_BENCH_WINDOW (8), GPTPU_BENCH_PLATFORM
 (force a jax platform, e.g. "cpu"; also disables the fallback recursion),
 GPTPU_BENCH_APP=device_kv (fuse the device-resident KV app behind the tick —
 decisions execute on-device, models/device_kv.py), GPTPU_BENCH_LAT_TICKS
-(default 15; 0 disables the closed-loop commit-latency phase).
+(default 15; 0 disables the closed-loop commit-latency phase),
+GPTPU_BENCH_PHASES (default 1; 0 disables the per-phase tick profile).
 """
 
 import json
@@ -36,6 +37,92 @@ BASELINE_DECISIONS_PER_SEC = 100_000.0  # north star: >=100k dec/s/chip
 
 FALLBACK_GROUPS = 1 << 16
 FALLBACK_TICKS = 10
+
+
+def _profile_phases(R, G, W, P, reps=8, exec_budget=4096, lag_budget=1024):
+    """Per-phase wall-time buckets for the LOADED tick (VERDICT r5 item 10).
+
+    XLA exposes no intra-program phase timers, so each bucket is measured
+    as a separately-jitted CUMULATIVE PREFIX of the tick body: returning
+    only ``intake_taken`` dead-code-eliminates everything past the intake
+    scatter (phases 0-2a), adding ``decided_now`` extends through accept +
+    tally (2b-2c), and the full (state, outbox) program is the whole tick.
+    A bucket is the delta between consecutive prefixes; ``outbox_pack`` is
+    the compact scatter as its own dispatch on a materialized outbox, and
+    ``control_summary_readback`` is the host's entire per-tick device
+    contact (compact buffer transfer + unpack, sweep-frontier dispatch +
+    O(rows) gather).  Fusion overlaps phase boundaries, so buckets need
+    not sum exactly to the fused ms/tick — they bound where the time
+    goes, not a cycle-exact attribution.  Profiles the plain consensus
+    tick regardless of GPTPU_BENCH_APP."""
+    import jax
+    import jax.numpy as jnp
+
+    from gigapaxos_tpu.ops.tick import (TickInbox, _compact_outbox_impl,
+                                        frontier_rows, paxos_tick_impl,
+                                        sweep_frontier, unpack_compact)
+    from gigapaxos_tpu.paxos import state as st
+
+    state = st.init_state(R, G, W)
+    state = st.create_groups(
+        state, np.arange(G, dtype=np.int32), np.ones((G, R), bool)
+    )
+    g = jnp.arange(G, dtype=jnp.int32)
+    req = jnp.zeros((R, P, G), jnp.int32).at[:, 0, :].set(
+        jnp.where(g[None, :] % R == jnp.arange(R)[:, None], 1 + g[None, :], 0)
+    )
+    inbox = TickInbox(req, jnp.zeros((R, P, G), jnp.bool_),
+                      jnp.ones((R,), jnp.bool_))
+
+    def timed(fn, *args):
+        out = fn(*args)  # compile + warm
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return 1e3 * (time.perf_counter() - t0) / reps, out
+
+    p_intake = jax.jit(lambda s, ib: paxos_tick_impl(s, ib)[1].intake_taken)
+
+    def _thru_tally(s, ib):
+        o = paxos_tick_impl(s, ib)[1]
+        return o.intake_taken, o.decided_now
+
+    p_tally = jax.jit(_thru_tally)
+    p_full = jax.jit(paxos_tick_impl)
+    t_intake, _ = timed(p_intake, state, inbox)
+    t_tally, _ = timed(p_tally, state, inbox)
+    t_full, (post, out) = timed(p_full, state, inbox)
+
+    p_pack = jax.jit(
+        lambda o: _compact_outbox_impl(o, exec_budget, lag_budget)
+    )
+    t_pack, packed = timed(p_pack, out)
+
+    rows = jnp.arange(16, dtype=jnp.int32)  # typical live outstanding rows
+    fr = sweep_frontier(post.exec_slot, post.member, inbox.alive)
+    jax.block_until_ready(frontier_rows(*fr, rows))  # warm both programs
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        unpack_compact(packed, R, G, exec_budget, lag_budget)
+        fr = sweep_frontier(post.exec_slot, post.member, inbox.alive)
+        for a in frontier_rows(*fr, rows):
+            np.asarray(a)
+    t_read = 1e3 * (time.perf_counter() - t0) / reps
+
+    return {
+        "intake_scatter": round(t_intake, 3),
+        "tally": round(max(t_tally - t_intake, 0.0), 3),
+        "exec_extract": round(max(t_full - t_tally, 0.0), 3),
+        "outbox_pack": round(t_pack, 3),
+        "control_summary_readback": round(t_read, 3),
+        "full_tick": round(t_full, 3),
+        "reps": reps,
+        "method": ("cumulative-prefix jits (DCE) + separate pack/readback "
+                   "dispatches; fusion overlap means buckets need not sum "
+                   "to ms_per_tick"),
+    }
 
 
 def run_bench() -> dict:
@@ -197,6 +284,8 @@ def run_bench() -> dict:
             "p50": round(lat_p50, 3), "p99": round(lat_p99, 3),
             "closed_loop_ticks": lat_ticks,
         }
+    if os.environ.get("GPTPU_BENCH_PHASES", "1") != "0":
+        result["phase_ms"] = _profile_phases(R, G, W, P)
     return result
 
 

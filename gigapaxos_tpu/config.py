@@ -81,6 +81,13 @@ class PaxosTuning:
     # transfer, PaxosInstanceStateMachine.java:1852).  Transfers are
     # journaled (OP_SYNC) so WAL replay reproduces them.
     auto_laggard_sync: bool = True
+    # Compact path: use the tick's device-computed donor summary (donor id,
+    # donor exec watermark/status, laggard exec — the l_* columns of the
+    # compact buffer) for those transfers, so repair scheduling never pulls
+    # [R, G] state to the host.  Off = legacy host scan re-derives the donor
+    # from a full exec_slot transfer (kept for A/B bit-identity tests; both
+    # paths journal the same OP_SYNC records).
+    device_donor_sel: bool = True
     # Bulk request-store capacity (0 = auto: 4 * max_groups, min 65536,
     # rounded up to a power of two).  Bounds requests in flight on the
     # propose_bulk path (MAX_OUTSTANDING_REQUESTS analog).

@@ -57,27 +57,68 @@ def _send_frame(sock: socket.socket, kind: int, payload: bytes) -> None:
     sock.sendall(_HDR.pack(len(payload) + 1, kind) + payload)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+#: Receive-buffer chunk: one recv() this size slices dozens-to-thousands of
+#: control-plane frames (typical frame: tens of bytes) out of kernel space
+#: in a single syscall.
+_RECV_CHUNK = 256 * 1024
+
+
+class FrameReader:
+    """Buffered frame extractor for one connection (MessageExtractor analog,
+    ``nio/MessageExtractor.java``): each ``recv()`` pulls up to
+    ``_RECV_CHUNK`` bytes and ``next_frame`` slices complete frames out of
+    the buffer without touching the socket again until it runs dry.
+
+    The previous implementation issued TWO blocking ``recv`` calls per frame
+    (exact header, exact payload).  At Mode B's capacity knee the inbound
+    control plane is thousands of tiny frames per tick and the syscall pair
+    per frame dominated the reader thread; batching turns that into
+    O(frames-per-chunk) frames per syscall (see
+    ``benchmarks/bench_transport.py``).
+
+    ``syscalls``/``frames`` counters are maintained for observability and
+    the micro-bench; the owner aggregates them into Transport.stats when the
+    connection closes."""
+
+    __slots__ = ("sock", "buf", "pos", "syscalls", "frames")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.buf = bytearray()
+        self.pos = 0  # parse cursor: buf[:pos] is consumed
+        self.syscalls = 0
+        self.frames = 0
+
+    def _fill(self, need: int) -> bool:
+        """Ensure ``need`` unconsumed bytes are buffered; False on EOF."""
+        while len(self.buf) - self.pos < need:
+            if self.pos:
+                # compact the consumed prefix before growing — the buffer
+                # stays bounded by one chunk + one partial frame
+                del self.buf[: self.pos]
+                self.pos = 0
+            try:
+                chunk = self.sock.recv(max(_RECV_CHUNK, need - len(self.buf)))
+            except OSError:
+                return False
+            self.syscalls += 1
+            if not chunk:
+                return False
+            self.buf.extend(chunk)
+        return True
+
+    def next_frame(self) -> Optional[Tuple[int, bytes]]:
+        if not self._fill(_HDR.size):
             return None
-        buf.extend(chunk)
-    return bytes(buf)
-
-
-def _recv_frame(sock: socket.socket) -> Optional[Tuple[int, bytes]]:
-    hdr = _recv_exact(sock, _HDR.size)
-    if hdr is None:
-        return None
-    ln, kind = _HDR.unpack(hdr)
-    if ln < 1 or ln - 1 > MAX_FRAME:
-        return None
-    payload = _recv_exact(sock, ln - 1) if ln > 1 else b""
-    if payload is None:
-        return None
-    return kind, payload
+        ln, kind = _HDR.unpack_from(self.buf, self.pos)
+        if ln < 1 or ln - 1 > MAX_FRAME:
+            return None  # corrupt length: drop the connection
+        if not self._fill(_HDR.size + ln - 1):
+            return None
+        start = self.pos + _HDR.size
+        self.pos = start + ln - 1
+        self.frames += 1
+        return kind, bytes(self.buf[start: self.pos])
 
 
 class _Peer:
@@ -283,6 +324,7 @@ class Transport:
 
     def _read_loop(self, conn: socket.socket) -> None:
         sender = "?"
+        reader = None
         try:
             if self.server_ssl_ctx is not None:
                 # handshake on the reader thread so a slow (or malicious)
@@ -296,7 +338,8 @@ class Transport:
                     # MUTUAL_AUTH): reject the connection
                     self._count("tls_rejects")
                     return
-            first = _recv_frame(conn)
+            reader = FrameReader(conn)
+            first = reader.next_frame()
             if first is None:
                 return
             kind, payload = first
@@ -305,7 +348,7 @@ class Transport:
             except (ValueError, AttributeError):
                 return  # bad hello; drop connection
             while not self.closed:
-                frame = _recv_frame(conn)
+                frame = reader.next_frame()
                 if frame is None:
                     return
                 kind, payload = frame
@@ -319,6 +362,9 @@ class Transport:
                     self._count("demux_errors")
                 profiler.update_delay("net.demux", t0)
         finally:
+            if reader is not None:
+                self._count("recv_syscalls", reader.syscalls)
+                self._count("recv_frames", reader.frames)
             try:
                 conn.close()
             except OSError:

@@ -87,6 +87,15 @@ class TickOutbox(NamedTuple):
                 maximum.  lag >= W means ring sync cannot catch it up and the
                 host must do a checkpoint transfer (StatePacket analog,
                 PaxosInstanceStateMachine.handleCheckpoint :1852).
+    donor:      int32 [R, G]    — control summary for that transfer: the best
+                live member to copy from (argmax post-tick exec watermark over
+                live members other than r, ties to the lowest replica id — the
+                same choice manager.sync_laggard's host scan makes), or -1
+                when no live member is strictly ahead.  Emitted for every
+                (r, g) but only meaningful where lag >= W.
+    donor_exec: int32 [R, G]    — the donor's post-tick exec watermark (the
+                value a checkpoint transfer adopts; 0 where donor == -1).
+    donor_status: int32 [R, G]  — the donor's post-tick group status.
     """
 
     exec_req: jnp.ndarray
@@ -97,6 +106,9 @@ class TickOutbox(NamedTuple):
     coord_id: jnp.ndarray
     decided_now: jnp.ndarray
     lag: jnp.ndarray
+    donor: jnp.ndarray
+    donor_exec: jnp.ndarray
+    donor_status: jnp.ndarray
 
 
 def _lexmax(n, c, axis):
@@ -524,6 +536,34 @@ def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
         prop_valid=fr3(prop_valid, state.prop_valid),
         prop_stop=fr3(prop_stop, state.prop_stop),
     )
+    # ------------- laggard repair control summary (donor selection) --------
+    # The host repair path used to re-derive the donor from a full [R, G]
+    # exec pull (manager.sync_laggard); emit it from the tick instead so the
+    # host never touches [R, G] state for repair.  Donor for laggard r =
+    # argmax post-tick exec over live members m != r, ties to the lowest m
+    # (Python ``max`` over ascending member ids picks the first maximum —
+    # match it exactly so journaled OP_SYNC records are bit-identical to the
+    # host scan).  Computed as top-2 over the replica axis: r's donor is the
+    # global best unless r IS the best, then the runner-up.
+    post_exec = new_state.exec_slot
+    ridx = jnp.broadcast_to(
+        jnp.arange(post_exec.shape[0], dtype=I32)[:, None], post_exec.shape
+    )
+    d_cand = jnp.where(member & alive[:, None], post_exec, NEG_INF)
+    t1_exec, t1_nid = _lexmax(d_cand, -ridx, axis=0)  # [G]
+    t2_exec, t2_nid = _lexmax(
+        jnp.where(ridx == -t1_nid[None, :], NEG_INF, d_cand), -ridx, axis=0
+    )
+    self_best = ridx == -t1_nid[None, :]
+    d_exec = jnp.where(self_best, t2_exec[None, :], t1_exec[None, :])
+    d_id = jnp.where(self_best, -t2_nid[None, :], -t1_nid[None, :])
+    # a transfer only helps when the donor is STRICTLY ahead (sync_laggard
+    # refuses otherwise); NEG_INF (no eligible donor) fails this too since
+    # exec watermarks are never negative
+    d_ok = d_exec > post_exec
+    d_status = jnp.take_along_axis(
+        new_state.status, jnp.clip(d_id, 0, post_exec.shape[0] - 1), axis=0
+    )
     outbox = TickOutbox(
         exec_req=jnp.where(al3, exec_req_out, NO_REQUEST),
         exec_stop=jnp.where(al3, exec_stop_out, False),
@@ -537,6 +577,9 @@ def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
             jnp.maximum(base_serve[None, :] - exec_slot, 0),
             0,
         ),
+        donor=jnp.where(d_ok, d_id, -1),
+        donor_exec=jnp.where(d_ok, d_exec, 0),
+        donor_status=jnp.where(d_ok, d_status, 0),
     )
     return new_state, outbox
 
@@ -561,6 +604,9 @@ class HostOutbox(NamedTuple):
     coord_id: "np.ndarray"
     decided_now: "np.ndarray"
     lag: "np.ndarray"
+    donor: "np.ndarray"
+    donor_exec: "np.ndarray"
+    donor_status: "np.ndarray"
 
 
 def pack_outbox_impl(out: TickOutbox) -> jnp.ndarray:
@@ -574,13 +620,17 @@ def pack_outbox_impl(out: TickOutbox) -> jnp.ndarray:
         out.coord_id.ravel(),
         out.decided_now.ravel(),
         out.lag.ravel(),
+        out.donor.ravel(),
+        out.donor_exec.ravel(),
+        out.donor_status.ravel(),
     ])
 
 
 def unpack_outbox(flat, R: int, P: int, W: int, G: int) -> HostOutbox:
     """Host-side inverse of :func:`pack_outbox_impl` (zero-copy views)."""
     flat = np.asarray(flat)
-    sizes = [R * W * G, R * W * G, R * G, R * G, R * P * G, G, G, R * G]
+    sizes = [R * W * G, R * W * G, R * G, R * G, R * P * G, G, G, R * G,
+             R * G, R * G, R * G]
     offs = np.cumsum([0] + sizes)
     cut = [flat[offs[i]:offs[i + 1]] for i in range(len(sizes))]
     return HostOutbox(
@@ -592,6 +642,9 @@ def unpack_outbox(flat, R: int, P: int, W: int, G: int) -> HostOutbox:
         coord_id=cut[5],
         decided_now=cut[6],
         lag=cut[7].reshape(R, G),
+        donor=cut[8].reshape(R, G),
+        donor_exec=cut[9].reshape(R, G),
+        donor_status=cut[10].reshape(R, G),
     )
 
 
@@ -645,6 +698,12 @@ class CompactHostOutbox(NamedTuple):
     e_stop: "np.ndarray"  # bool [n_exec]
     l_rep: "np.ndarray"   # i32 [min(lag_n, lag_budget)]
     l_row: "np.ndarray"   # i32 [min(lag_n, lag_budget)]
+    # control summary per flagged laggard: everything a checkpoint transfer
+    # needs, so repair never re-derives from [R, G] state (see TickOutbox)
+    l_donor: "np.ndarray"  # i32 — device-selected donor replica (-1 = none)
+    l_dexec: "np.ndarray"  # i32 — donor's post-tick exec watermark
+    l_dstat: "np.ndarray"  # i32 — donor's post-tick group status
+    l_lexec: "np.ndarray"  # i32 — the laggard's own post-tick exec watermark
 
 
 def _compact_outbox_impl(out: TickOutbox, exec_budget: int,
@@ -699,6 +758,10 @@ def _compact_outbox_impl(out: TickOutbox, exec_budget: int,
         scat(row),
         lscat(rep2),
         lscat(row2),
+        lscat(out.donor),
+        lscat(out.donor_exec),
+        lscat(out.donor_status),
+        lscat(out.exec_base + out.exec_count),  # laggard's post-tick exec
     ])
 
 
@@ -727,18 +790,21 @@ class CompactLayout:
     here, not silent corruption in a hand-computed twin offset.
 
     Section order: header[3] | taken_bits[R*G] | e_rid[E] | e_meta[E] |
-    e_slot[E] | e_row[E] | l_rep[Lb] | l_row[Lb] | app extras
+    e_slot[E] | e_row[E] | l_rep[Lb] | l_row[Lb] | l_donor[Lb] |
+    l_dexec[Lb] | l_dstat[Lb] | l_lexec[Lb] | app extras
     (device-app: e_resp[E] | e_miss[E])."""
 
     HEADER = 3  # n_exec, decided_total, lag_n
+
+    LAG_COLS = 6  # rep, row, donor, donor exec, donor status, laggard exec
 
     def __init__(self, R: int, G: int, exec_budget: int, lag_budget: int):
         self.R, self.G = R, G
         self.E, self.Lb = exec_budget, lag_budget
         self.o_taken = self.HEADER
         self.o_exec = self.o_taken + R * G      # 4 E-sized exec columns
-        self.o_lag = self.o_exec + 4 * self.E   # 2 Lb-sized laggard columns
-        self.base = self.o_lag + 2 * self.Lb    # app extras start here
+        self.o_lag = self.o_exec + 4 * self.E   # LAG_COLS Lb-sized columns
+        self.base = self.o_lag + self.LAG_COLS * self.Lb  # app extras
         self.o_resp = self.base                 # device-app: KV responses
         self.o_miss = self.base + self.E        # device-app: descriptor miss
         self.total_plain = self.base
@@ -766,7 +832,11 @@ def unpack_compact(flat, R: int, G: int, exec_budget: int,
     assert o == L.o_lag
     ln = min(lag_n, Lb)
     l_rep = flat[o:o + ln]; o += Lb
-    l_row = flat[o:o + ln]
+    l_row = flat[o:o + ln]; o += Lb
+    l_donor = flat[o:o + ln]; o += Lb
+    l_dexec = flat[o:o + ln]; o += Lb
+    l_dstat = flat[o:o + ln]; o += Lb
+    l_lexec = flat[o:o + ln]
     return CompactHostOutbox(
         n_exec=n_exec,
         decided_total=decided_total,
@@ -779,7 +849,88 @@ def unpack_compact(flat, R: int, G: int, exec_budget: int,
         e_stop=(e_meta >> 8).astype(bool),
         l_rep=l_rep,
         l_row=l_row,
+        l_donor=l_donor,
+        l_dexec=l_dexec,
+        l_dstat=l_dstat,
+        l_lexec=l_lexec,
     )
+
+
+# --------------------------------------------------------------------------
+# Control summaries beyond the compact buffer: payload-sweep frontier and the
+# single-device demand fold.  Both keep the flat compact program byte-
+# identical — they are SEPARATE dispatches (frontier) or fuse into the
+# single-device program where no GSPMD partitioner is involved (demand).
+# --------------------------------------------------------------------------
+
+
+def sweep_frontier_impl(exec_slot, member, alive):
+    """Per-group payload-sweep frontier, the device twin of the host
+    reductions ``_sweep_outstanding`` used to run over full ``[R, G]``
+    numpy arrays:
+
+    * ``amin``: min exec watermark over MEMBERS (dead included — a slot
+      inside a dead member's ring-reach gap must keep its payload for ring
+      replay on revival); int32 max where a group has no members.
+    * ``base``: max exec watermark over members (the ring-rotation bound);
+      int32 min where a group has no members.
+    * ``live``: any member currently alive.
+
+    Returns ``(amin[G], base[G], live[G])`` — device arrays.  The manager
+    immediately gathers the rows with live outstanding records
+    (:func:`frontier_rows`, enqueued in the same dispatch window, before
+    the next tick program) and stashes only the [rows] results, so the
+    host never transfers or reduces ``[R, G]`` and never queues a device
+    program at tick completion."""
+    amin = jnp.min(jnp.where(member, exec_slot, jnp.int32(2**31 - 1)), axis=0)
+    base = jnp.max(jnp.where(member, exec_slot, NEG_INF), axis=0)
+    live = jnp.any(member & alive[:, None], axis=0)
+    return amin, base, live
+
+
+#: Own dispatch on purpose: under the mesh the inputs are
+#: P(replica, groups)-sharded and the replica-axis reductions become
+#: collectives — correct in an ordinary global-view program, but fusing them
+#: into the shard_map tick's jit would trip the documented check_rep
+#: miscompile (see parallel/shard_tick module docstring).
+sweep_frontier = jax.jit(sweep_frontier_impl)
+
+
+def _frontier_rows_impl(amin, base, live, rows):
+    return (jnp.take(amin, rows, mode="clip"),
+            jnp.take(base, rows, mode="clip"),
+            jnp.take(live, rows, mode="clip"))
+
+
+#: O(rows) gather + device->host transfer of a stashed frontier.  One
+#: compile per padded row-count bucket; the manager pads to powers of two.
+frontier_rows = jax.jit(_frontier_rows_impl)
+
+
+def _paxos_tick_compact_demand_impl(state, inbox: TickInbox, demand,
+                                    own_row: int, exec_budget: int,
+                                    lag_budget: int, decay: float):
+    """Single-device twin of shard_tick's demand-folding compact tick:
+    tick + compaction + placement demand EWMA in ONE program.
+
+    The fold consumes per-row INTAKE (sum of ``intake_taken`` over entry
+    and p slots — exactly the ``taken_bits`` popcount the host fold used to
+    compute in an O(G*P) numpy loop per tick), so the host-visible demand
+    samples are bit-identical to the old host fold.  Fusing is safe here
+    precisely because there is no mesh: the GSPMD same-jit miscompile that
+    forces the mesh path's fold into a separate dispatch does not exist in
+    a single-device program, and the flat compact buffer stays
+    byte-identical."""
+    state, out = paxos_tick_impl(state, inbox, own_row, exec_budget)
+    per_row = jnp.sum(out.intake_taken.astype(demand.dtype), axis=(0, 1))
+    new_demand = decay * demand + per_row
+    return state, _compact_outbox_impl(out, exec_budget, lag_budget), new_demand
+
+
+paxos_tick_compact_demand = jax.jit(
+    _paxos_tick_compact_demand_impl, donate_argnums=(0, 2),
+    static_argnums=(3, 4, 5, 6),
+)
 
 
 def make_inbox(n_replicas: int, n_groups: int, per_tick: int) -> TickInbox:

@@ -80,6 +80,12 @@ _OUTBOX_SPECS = dict(
     coord_id=P(GROUPS_AXIS),
     decided_now=P(GROUPS_AXIS),
     lag=_RG,
+    # laggard-repair control summary: per (laggard replica, group), computed
+    # from the replica-gathered exec watermarks inside the body and sliced
+    # back to local rows like the other replica-led fields.
+    donor=_RG,
+    donor_exec=_RG,
+    donor_status=_RG,
 )
 
 
@@ -130,6 +136,9 @@ def shard_tick_body(mesh: Mesh, own_row: int = -1, exec_budget: int = 0):
                 exec_count=sl(out.exec_count),
                 intake_taken=sl(out.intake_taken),
                 lag=sl(out.lag),
+                donor=sl(out.donor),
+                donor_exec=sl(out.donor_exec),
+                donor_status=sl(out.donor_status),
             )
         return new, out
 

@@ -46,8 +46,9 @@ _MGR_SEQ = _itertools.count()
 from . import state as st
 from .bulkstore import BulkOverrun, BulkStore
 from ..ops.tick import (CompactHostOutbox, HostOutbox, TickInbox,
-                        paxos_tick_compact, paxos_tick_packed,
-                        unpack_compact, unpack_outbox)
+                        frontier_rows, paxos_tick_compact,
+                        paxos_tick_compact_demand, paxos_tick_packed,
+                        sweep_frontier, unpack_compact, unpack_outbox)
 
 
 @dataclass
@@ -154,10 +155,23 @@ class PaxosManager:
         self._bulk_chunks: list = []  # FIFO of staged rid arrays
         self._bulk_leftover = np.zeros(0, np.int64)  # queued, not yet placed
         self._bulk_placed = None  # (rids, entries, ps, rows) of last tick
-        self._lag_pending = (np.zeros(0, np.int64), np.zeros(0, np.int64))
+        #: the last completed tick's compacted laggard table — the l_*
+        #: columns (rep, row, donor, donor exec, donor status, laggard
+        #: exec): everything a checkpoint transfer needs, device-selected
+        z0 = np.zeros(0, np.int64)
+        self._lag_pending = (z0, z0, z0, z0, z0, z0)
         #: (replica, row) transfers noticed during tick completion, run at
         #: the next tick() top after a pipeline drain (watermark/blob skew)
         self._lag_sync_due: list = []
+        #: pairs repaired at the previous tick() top: the pipelined outbox
+        #: completed during that same drain re-flags them from pre-repair
+        #: state, and without this filter the next tick would pay a
+        #: pipeline drain just to find every entry already healed
+        self._repaired_last: set = set()
+        #: device sweep frontier (urows + amin/base/live [rows] gathers,
+        #: _frontier_gather) stashed at the dispatch whose completion will
+        #: sweep — see _complete_tick
+        self._sweep_every = 64
         #: HOST-APPLIED execution watermark [R, G]: how far each replica's
         #: app has actually executed (device exec_slot runs one pipelined
         #: tick ahead of it).  The payload sweep must judge "everyone
@@ -264,6 +278,12 @@ class PaxosManager:
                 from ..parallel import shard_tick as _stk2
 
                 self._demand_dev = _stk2.init_demand(self.mesh, self.G)
+            elif self._use_compact and not self._device_app:
+                # single-device compact path: the intake-popcount fold runs
+                # fused inside paxos_tick_compact_demand (no mesh, so the
+                # GSPMD same-jit hazard doesn't apply) instead of the old
+                # O(G*P) host popcount per tick in _process_compact
+                self._demand_dev = jnp.zeros(self.G, jnp.float32)
         # first-occurrence scratch (generation-tagged so no per-tick clear)
         self._scr_pos = np.zeros(self.R * self.G, np.int64)
         self._scr_gen = np.zeros(self.R * self.G, np.int64)
@@ -519,6 +539,24 @@ class PaxosManager:
         gs, _per = self.shard_geometry()
         lo, hi = st.shard_row_range(self.G, gs, shard)
         return sum(1 for r in self.rows._free if lo <= r < hi)
+
+    @_locked
+    def blob_bytes_of_row(self, row: int) -> int:
+        """Checkpoint-blob size a migration of ``row`` would transfer (the
+        rebalancer's move-cost estimator; MigrationStats.bytes_transferred
+        records the same quantity after the fact).  0 for free rows.
+
+        Serializes one member's checkpoint, so call it only at plan time
+        (the rebalancer probes a handful of near-tie candidates per plan,
+        and plans are min-interval paced) — never per tick."""
+        name = self.rows.name(int(row))
+        if name is None:
+            return 0
+        for r in range(self.R):
+            if self.alive[r] and self._member_np[r, int(row)]:
+                blob = self.apps[r].checkpoint(name)
+                return len(blob) if blob is not None else 0
+        return 0
 
     def demand_snapshot(self):
         """Host view of the per-group demand EWMA [G] (None when the
@@ -1343,8 +1381,43 @@ class PaxosManager:
         skewed pair permanently skips the slots between them (found live:
         a released write missing on every sync-repaired replica)."""
         due, self._lag_sync_due = self._lag_sync_due, []
+        repaired, self._repaired_last = self._repaired_last, set()
         if not due:
             return
+        if self._use_compact and self.cfg.paxos.device_donor_sel:
+            # Control-summary path: O(due) host work, no [R, G] pulls.  The
+            # drain completes the in-flight tick, so _lag_pending becomes
+            # the LATEST tick's device-computed laggard table — which by
+            # construction matches the current device state exactly (no
+            # further tick has been dispatched).  An entry absent from that
+            # table is no longer lagging (typically: repaired last call and
+            # re-flagged from the pre-repair pipelined outbox — filtered
+            # via _repaired_last before paying the drain).
+            cand, seen = [], set()
+            for r_, row_ in due:
+                key = (int(r_), int(row_))
+                if key in seen or key in repaired or not self.alive[key[0]]:
+                    continue
+                seen.add(key)
+                cand.append(key)
+            if not cand:
+                return
+            self.drain_pipeline()  # host apps catch up; refresh _lag_pending
+            latest = {
+                (int(r_), int(w_)): (int(d_), int(de_), int(ds_), int(le_))
+                for r_, w_, d_, de_, ds_, le_ in zip(*self._lag_pending)
+            }
+            for key in cand:
+                info = latest.get(key)
+                if info is None or info[0] < 0:  # healed / no live donor
+                    continue
+                name = self.rows.name(key[1])
+                if name is None:
+                    continue
+                if self._sync_from_summary(key[0], key[1], name, *info):
+                    self._repaired_last.add(key)
+            return
+        # legacy host scan (full-outbox mode / device_donor_sel off):
         # re-check lag against CURRENT state first: pipelined completion
         # re-enqueues from the pre-repair outbox, and paying a pipeline
         # drain just to have every sync refuse (donor not ahead) would
@@ -1409,11 +1482,47 @@ class PaxosManager:
         elif self._mesh_tick is not None:
             self.state, packed = self._mesh_tick(self.state, inbox)
         elif self._use_compact:
-            self.state, packed = paxos_tick_compact(
-                self.state, inbox, -1, self._exec_budget, self._lag_budget
-            )
+            if self._demand_dev is not None:
+                # placement: the intake-demand EWMA folds on device inside
+                # the fused program (the mesh path's separate-dispatch twin
+                # lives in make_shardmap_tick_compact)
+                self.state, packed, self._demand_dev = (
+                    paxos_tick_compact_demand(
+                        self.state, inbox, self._demand_dev, -1,
+                        self._exec_budget, self._lag_budget,
+                        self._placement.decay,
+                    )
+                )
+                self._placement.adopt_device(self._demand_dev)
+            else:
+                self.state, packed = paxos_tick_compact(
+                    self.state, inbox, -1, self._exec_budget, self._lag_budget
+                )
         else:
             self.state, packed = paxos_tick_packed(self.state, inbox, -1)
+        # Device sweep frontier: computed ONLY at the dispatch whose
+        # completion is scheduled to run _sweep_outstanding (1 in 64 ticks),
+        # from THIS tick's post-state — it travels with the packed outbox so
+        # the sweep consumes amin/base exactly as of the tick it completes.
+        # The O(rows) frontier_rows gather is dispatched HERE too, right
+        # behind sweep_frontier and before the next tick program enters the
+        # stream: the rows holding records are host state already known at
+        # dispatch, and a completion-time gather would queue behind (and on
+        # CPU contend with) the next tick's O(G) program — the one device
+        # round-trip this plane exists to avoid.  By completion the [rows]
+        # results are long finished and the sweep is memcpy + O(records).
+        # A drain that completes off-schedule just finds frontier=None and
+        # falls back to the host reductions (correct, only slower).
+        frontier = None
+        done_at = self.tick_num + (2 if self.cfg.paxos.pipeline_ticks else 1)
+        if done_at % self._sweep_every == 0 and (
+            self.outstanding or (self.bulk is not None and self.bulk.n_live)
+        ):
+            fr = sweep_frontier(
+                self.state.exec_slot, self.state.member, inbox.alive
+            )
+            if fr is not None:
+                frontier = self._frontier_gather(fr)
         if self.wal is not None:
             self.wal.log_inbox(self.tick_num, inbox)
         self.tick_num += 1
@@ -1437,18 +1546,19 @@ class PaxosManager:
                 # of dropping it, so callers polling tick() never miss a
                 # completed outbox on sync-due ticks
                 out, self._drained_out = self._drained_out, None
-            self._pending_out = (packed, placed, bulk_placed)
+            self._pending_out = (packed, placed, bulk_placed, frontier)
             # a due checkpoint must cover on-host effects of every tick the
             # device state contains — drain the one-tick pipeline first
             if self.wal is not None and self.wal.checkpoint_due():
                 self.drain_pipeline()
         else:
-            out = self._complete_tick(packed, placed, bulk_placed)
+            out = self._complete_tick(packed, placed, bulk_placed, frontier)
         if self.wal is not None:
             self.wal.maybe_checkpoint()
         return out
 
-    def _complete_tick(self, packed, placed: list, bulk_placed=None):
+    def _complete_tick(self, packed, placed: list, bulk_placed=None,
+                       frontier=None):
         """Consume one tick's outbox (unpacking = the device sync point):
         requeue rejected intake, execute the ordered decision stream,
         release durable callbacks, periodic GC."""
@@ -1476,8 +1586,8 @@ class PaxosManager:
                 out = unpack_outbox(packed, self.R, self.P, self.W, self.G)
             self._process_outbox(out, placed, bulk_placed)
         self._flush_callbacks()
-        if self.tick_num % 64 == 0:
-            self._sweep_outstanding()
+        if self.tick_num % self._sweep_every == 0:
+            self._sweep_outstanding(frontier)
         if (
             self.cfg.paxos.deactivation_ticks > 0
             and self.tick_num % 256 == 0
@@ -1785,7 +1895,9 @@ class PaxosManager:
                 per_row += (bits & 1).sum(axis=0)
                 bits >>= 1
             self._placement.observe_intake(per_row)
-        self._lag_pending = (co.l_rep.copy(), co.l_row.copy())
+        self._lag_pending = (co.l_rep.copy(), co.l_row.copy(),
+                             co.l_donor.copy(), co.l_dexec.copy(),
+                             co.l_dstat.copy(), co.l_lexec.copy())
         # During journal replay (_replay_process installed) laggard repair
         # must come ONLY from journaled OP_SYNC records: the live run's
         # donor choice may have been constrained by liveness that replay
@@ -1801,9 +1913,9 @@ class PaxosManager:
             # inside completion pairs the donor's device watermark with a
             # host app state one pipelined tick behind it, and the laggard
             # would permanently skip the difference.
-            self._lag_sync_due.extend(zip(*self._lag_pending))
+            self._lag_sync_due.extend(zip(*self._lag_pending[:2]))
 
-    def _sweep_outstanding(self) -> None:
+    def _sweep_outstanding(self, frontier=None) -> None:
         """Drop responded records whose payload can never be needed again:
         every member has executed past the slot, OR the slot has rotated
         out of every decision ring (slot <= base - W), in which case any
@@ -1814,9 +1926,20 @@ class PaxosManager:
         keep its payload: when that member revives with gap < W it
         catches up by ring REPLAY, and executing a swept slot would
         silently skip it (found live: a released write missing on the
-        revived replica, then spread to others by checkpoint donation)."""
+        revived replica, then spread to others by checkpoint donation).
+
+        ``frontier`` is the device control summary for this sweep —
+        ``(urows, amin, base, live)``: the record rows collected at
+        dispatch and the matching [rows] gathers of the reductions
+        ``ops.tick.sweep_frontier`` computed from the completing tick's
+        post-state — and routes to the O(records) path below.  ``None``
+        (off-schedule drains, full-outbox mode, direct test calls) keeps
+        the original [R, G] host reductions."""
         if not self.outstanding and (self.bulk is None
                                      or self.bulk.n_live == 0):
+            return
+        if frontier is not None:
+            self._sweep_with_frontier(frontier)
             return
         # "passed" is judged against the HOST-APPLIED watermark (see
         # _host_exec): device exec includes the in-flight pipelined tick's
@@ -1871,6 +1994,94 @@ class PaxosManager:
             del self.outstanding[rid]
             self.stats["swept"] += 1
 
+    def _frontier_gather(self, fr):
+        """Dispatch-time half of the frontier sweep: collect the rows
+        holding live records (EVERY valid/outstanding record's row, placed
+        or not — a record in flight at dispatch may be responded by the
+        completion that consumes this gather) and enqueue the O(rows)
+        ``frontier_rows`` gather right behind ``sweep_frontier``, clip-
+        padded to a power-of-two bucket so the gather jit doesn't retrace
+        per count.  Returns ``(urows, amin, base, live)`` with the [rows]
+        results still on device — the completing tick blocks on nothing
+        bigger than this."""
+        s = self.bulk
+        rows_parts = []
+        if s is not None and s.n_live:
+            rws = s.row[s.valid]
+            if len(rws):
+                rows_parts.append(rws.astype(np.int32))
+        if self.outstanding:
+            rows_parts.append(np.fromiter(
+                (rec.row for rec in self.outstanding.values()),
+                np.int32, len(self.outstanding)))
+        if not rows_parts:
+            return None
+        urows = np.unique(np.concatenate(rows_parts)
+                          if len(rows_parts) > 1 else rows_parts[0])
+        k = max(16, 1 << int(len(urows) - 1).bit_length())
+        padded = np.zeros(k, np.int32)
+        padded[:len(urows)] = urows
+        am, bs, lv = frontier_rows(*fr, padded)
+        return urows, am, bs, lv
+
+    def _sweep_with_frontier(self, frontier) -> None:
+        """O(records) sweep off the device control summary: the [G]
+        reductions (all-member exec min, device exec base, member liveness)
+        ran inside ``sweep_frontier`` on the completing tick's post-state —
+        which at consumption time IS the host-applied watermark, deliveries
+        having just run — and the rows holding records were gathered back
+        at dispatch (:meth:`_frontier_gather`), so the host cost here is a
+        [rows] memcpy plus the record loop: it scales with live records,
+        never [R, G], and never queues a device program mid-tick.
+
+        A record whose row is missing from the dispatch-time gather (can
+        only arise from repair/test paths mutating records between dispatch
+        and completion) is conservatively kept for the next sweep.
+
+        Equivalences with the host path: ``slot < amin[row]`` ⇔ every
+        member's watermark is past the slot; ``base`` here is the completed
+        tick's exec (the host path reads the in-flight tick's — i.e. this
+        sweeps a one-tick-older rotation bound: strictly conservative).
+        ``live`` is dispatch-time liveness — at most one pipelined tick
+        staler than the host path's read of self.alive, and only ever a
+        keep-guard."""
+        urows, am, bs, lv = frontier
+        amin = np.asarray(am)[:len(urows)]
+        base = np.asarray(bs)[:len(urows)]
+        live = np.asarray(lv)[:len(urows)]
+        s = self.bulk
+        if s is not None and s.n_live:
+            cand = np.nonzero(s.valid & s.responded & (s.slot >= 0))[0]
+            if len(cand):
+                crows = s.row[cand]
+                ix = np.minimum(np.searchsorted(urows, crows),
+                                len(urows) - 1)
+                sel = cand[(urows[ix] == crows) & live[ix]
+                           & ((s.slot[cand] < amin[ix])
+                              | (s.slot[cand] < base[ix] - self.W))]
+                if len(sel):
+                    s.valid[sel] = False
+                    s.payload[sel] = None
+                    s.response[sel] = None
+                    s.n_live -= len(sel)
+                    s.done += len(sel)
+                    self.stats["swept"] += len(sel)
+        if not self.outstanding:
+            return
+        dead = []
+        for rid, rec in self.outstanding.items():
+            if not rec.responded or rec.slot < 0:
+                continue
+            i = int(np.searchsorted(urows, rec.row))
+            if i >= len(urows) or urows[i] != rec.row or not live[i]:
+                continue
+            if rec.slot < amin[i] or rec.slot < base[i] - self.W:
+                dead.append(rid)
+        for rid in dead:
+            self._row_outstanding[self.outstanding[rid].row] -= 1
+            del self.outstanding[rid]
+            self.stats["swept"] += 1
+
     # --------------------------------------------------------------- liveness
     def set_alive(self, r: int, up: bool) -> None:
         self.alive[r] = up
@@ -1917,6 +2128,33 @@ class PaxosManager:
         self.stats["checkpoint_transfers"] += 1
         return True
 
+    def _sync_from_summary(self, r: int, row: int, name: str, donor: int,
+                           donor_exec: int, donor_status: int,
+                           old_exec: int) -> bool:
+        """Checkpoint transfer driven entirely by the device control summary
+        (the compact buffer's l_* columns): donor id, donor watermark/status
+        and the laggard's own watermark all come from the last completed
+        tick — which, after the caller's pipeline drain, IS the current
+        device state — so nothing here reads ``[R, G]`` arrays.  Journals
+        the same OP_SYNC record (exact transferred values) the host-scan
+        :meth:`sync_laggard` would."""
+        if not self.alive[donor] or not self._member_np[r, row]:
+            # liveness/membership moved between the tick and the repair —
+            # rare enough to pay the host scan, which re-derives the donor
+            # from current state
+            return self.sync_laggard(r, name)
+        if donor_exec <= old_exec:
+            return False
+        ckpt = self.apps[donor].checkpoint(name)
+        if self.wal is not None:
+            self.wal.log_sync(r, name, int(donor), int(donor_exec),
+                              int(donor_status), ckpt)
+        self._apply_sync_values(r, int(row), name, int(donor_exec),
+                                int(donor_status), ckpt,
+                                old_exec=int(old_exec))
+        self.stats["checkpoint_transfers"] += 1
+        return True
+
     @_locked
     def apply_sync(self, r: int, name: str, donor_exec: int,
                    donor_status: int, ckpt: bytes) -> bool:
@@ -1932,8 +2170,9 @@ class PaxosManager:
 
     def _apply_sync_values(self, r: int, row: int, name: str,
                            donor_exec: int, donor_status: int,
-                           ckpt: bytes) -> None:
-        old_exec = int(np.asarray(self.state.exec_slot[r, row]))
+                           ckpt: bytes, old_exec: Optional[int] = None) -> None:
+        if old_exec is None:
+            old_exec = int(np.asarray(self.state.exec_slot[r, row]))
         self.apps[r].restore(name, ckpt)
         self._host_exec[r, row] = max(int(self._host_exec[r, row]),
                                       donor_exec)
@@ -1973,6 +2212,23 @@ class PaxosManager:
                     "auto_sync_laggards() needs the tick's outbox in "
                     "full-outbox mode"
                 )
+            if out is None and self.cfg.paxos.device_donor_sel:
+                # control-summary path: after the drain, _lag_pending is the
+                # latest completed tick's table and its donor columns match
+                # the current device state — repair straight from it, no
+                # [R, G] pulls (see _sync_from_summary)
+                self.drain_pipeline()
+                n = 0
+                for r_, row_, d_, de_, ds_, le_ in zip(*self._lag_pending):
+                    r = int(r_)
+                    if not self.alive[r] or int(d_) < 0:
+                        continue
+                    name = self.rows.name(int(row_))
+                    if name and self._sync_from_summary(
+                            r, int(row_), name, int(d_), int(de_),
+                            int(ds_), int(le_)):
+                        n += 1
+                return n
             src = out if out is not None else None
             l_rep = src.l_rep if src is not None else self._lag_pending[0]
             l_row = src.l_row if src is not None else self._lag_pending[1]

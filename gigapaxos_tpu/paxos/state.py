@@ -25,6 +25,15 @@ Request payloads never enter the device: requests are ``int32`` ids handed
 out by the host (see ``paxos/manager.py``); the device orders ids, the host
 owns bytes.  ``NO_REQUEST`` (0) marks empty slots and no-op decisions.
 
+Host-access contract: the ``[R, G]`` scalars are DEVICE-summarized, never
+host-scanned per tick.  Control decisions that need cross-replica reductions
+of ``exec_slot``/``status``/``member`` — laggard donor election, the sweep
+frontier, intake-demand folds — run inside the tick program and surface
+through the compact outbox / ``ops.tick.sweep_frontier`` (see the control-
+summary plane in ``paxos/manager.py``), so host work per tick scales with
+the handful of rows that need attention, not with G.  Host code pulling a
+full ``[R, G]`` field outside recovery/checkpoint paths is a regression.
+
 The replica axis doubles as the mesh axis ``replica`` when sharded (see
 ``parallel/mesh.py``): reductions over axis 0 become ICI collectives under
 jit+GSPMD.

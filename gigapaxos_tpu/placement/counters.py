@@ -2,15 +2,22 @@
 
 Two intake paths feed one facade:
 
-* **Device fold** (mesh + compact path): per-group ``decided_now`` [G] never
+* **Device fold** (compact paths): per-group ``decided_now`` [G] never
   reaches the host in compact mode (only its sum survives the flat buffer),
-  so the EWMA fold ``d' = decay*d + decided_now`` runs *inside* the compact
-  dispatch — the demand array stays device-resident, sharded
-  ``P(GROUPS_AXIS)``, and costs one fused multiply-add per tick.  The host
-  pulls a snapshot only every ``sample_every_ticks`` ticks.
-* **Host fold** (packed / non-mesh paths): the host already sees per-row
-  intake (``taken_bits`` popcounts in compact mode, ``intake_taken`` sums
-  otherwise), so ``observe_intake`` folds the same EWMA in numpy.
+  so the EWMA fold runs on device and the demand array stays
+  device-resident; the host pulls a snapshot only every
+  ``sample_every_ticks`` ticks.  The mesh path folds ``decided_now``
+  (``d' = decay*d + decided_now``) in a separate elementwise dispatch,
+  ``P(GROUPS_AXIS)``-sharded (see the GSPMD note in
+  ``parallel/shard_tick.py``); the single-device path fuses the equivalent
+  per-row intake fold (``sum(intake_taken)`` — what the host popcount used
+  to compute from ``taken_bits``) straight into the tick program
+  (``ops.tick.paxos_tick_compact_demand``), which no GSPMD hazard forbids
+  there.
+* **Host fold** (full-outbox path, and the device-app compact path whose
+  fused program predates the fold): the host sees per-row intake
+  (``intake_taken`` sums, or ``taken_bits`` popcounts in compact mode), so
+  ``observe_intake`` folds the same EWMA in numpy.
 
 Counters are ADVISORY: they are excluded from WAL/snapshot on purpose — a
 recovered node restarts with cold counters and simply waits out the

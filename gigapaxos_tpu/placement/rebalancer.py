@@ -45,7 +45,8 @@ class ShardRebalancer:
     def __init__(self, n_groups: int, groups_shards: int, *,
                  skew_threshold: float = 2.0, hysteresis: float = 1.25,
                  min_interval_ticks: int = 64, min_moves_between: int = 0,
-                 max_moves_per_plan: int = 4, min_shard_load: float = 1e-3):
+                 max_moves_per_plan: int = 4, min_shard_load: float = 1e-3,
+                 blob_tolerance: float = 0.9):
         self.n_groups = int(n_groups)
         self.groups_shards = int(groups_shards)
         self.rows_per_shard = self.n_groups // self.groups_shards
@@ -55,6 +56,10 @@ class ShardRebalancer:
         self.min_moves_between = int(min_moves_between)
         self.max_moves_per_plan = int(max_moves_per_plan)
         self.min_shard_load = float(min_shard_load)
+        #: when a ``blob_bytes`` estimator is supplied to :meth:`propose`,
+        #: rows within this demand fraction of the hot shard's hottest row
+        #: count as "equally hot" and the cheapest-to-move one is shed
+        self.blob_tolerance = float(blob_tolerance)
         self._last_plan_tick: Optional[int] = None
         self._armed = True  # hysteresis state: trigger armed?
         self._moves_since_plan = 0
@@ -79,12 +84,22 @@ class ShardRebalancer:
 
     # ------------------------------------------------------------- planning
     def propose(self, tick: int, demand: np.ndarray,
-                free_rows_in_shard) -> MigrationPlan:
+                free_rows_in_shard, blob_bytes=None) -> MigrationPlan:
         """Plan up to ``max_moves_per_plan`` moves off the hottest shard.
 
         ``demand`` is the [G] EWMA snapshot; ``free_rows_in_shard(k)`` must
         return how many free rows destination shard ``k`` has — a move is
         only planned into capacity that exists.
+
+        ``blob_bytes`` (optional, ``row -> int``) estimates the checkpoint
+        blob a migration of that row would transfer (the quantity
+        ``MigrationStats.bytes_transferred`` records after the fact).  When
+        given, rows within ``blob_tolerance`` of the hot shard's top demand
+        are treated as equally hot and the LIGHTEST blob among them is shed
+        — a heavy-state group is passed over for an equally hot light one,
+        since either move sheds the same load but the light one stops the
+        world for a fraction of the transfer.  The tolerance bounds the
+        heat sacrificed, so skew convergence is unaffected.
         """
         plan = MigrationPlan(tick=tick)
         gs, per = self.groups_shards, self.rows_per_shard
@@ -121,6 +136,17 @@ class ShardRebalancer:
             lo, hi = src * per, (src + 1) * per
             seg = demand[lo:hi]
             row = lo + int(seg.argmax())
+            if blob_bytes is not None and float(seg[row - lo]) > 0.0:
+                near = np.nonzero(
+                    seg >= self.blob_tolerance * float(seg[row - lo])
+                )[0]
+                if len(near) > 1:
+                    # ties (and near-ties) go to the cheapest transfer;
+                    # index breaks exact byte ties for determinism
+                    row = lo + int(min(
+                        near, key=lambda j: (int(blob_bytes(lo + int(j))),
+                                             int(j))
+                    ))
             d = float(demand[row])
             if d <= 0.0:
                 break  # nothing hot left to shed

@@ -139,7 +139,14 @@ class PaxosLogger:
         donor id: under pipelined ticks the sync is applied one tick after
         the OP_TICK appended at dispatch, so replay re-deriving the
         transfer from the donor's replay-time state would adopt a skewed
-        watermark and diverge from the crash run."""
+        watermark and diverge from the crash run.
+
+        This also makes the record the single authority across donor-
+        selection implementations: the device control-summary path
+        (cfg.paxos.device_donor_sel, manager._sync_from_summary) and the
+        host scan (sync_laggard) journal byte-identical OP_SYNC records
+        for the same repair, and replay applies either verbatim — a crash
+        run under one selector replays correctly under the other."""
         self.journal.append(records.dumps(
             (OP_SYNC, r, name, donor, donor_exec, donor_status, ckpt)
         ))
@@ -416,6 +423,11 @@ def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
     # (mostly already-repaired) transfer attempts
     if hasattr(m, "_lag_sync_due"):
         m._lag_sync_due.clear()
+    # the repaired-last-call filter must not carry replay-era keys into the
+    # first live tick: a key wrongly present would skip a genuinely due
+    # repair (the filter is only valid for one completion's re-flags)
+    if hasattr(m, "_repaired_last"):
+        m._repaired_last.clear()
 
 
 def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,

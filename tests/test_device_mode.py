@@ -261,7 +261,8 @@ def test_compact_layout_single_source_of_truth():
     assert L.o_taken == 3
     assert L.o_exec == 3 + R * G
     assert L.o_lag == L.o_exec + 4 * E
-    assert L.o_resp == L.o_lag + 2 * Lb
+    assert L.o_resp == L.o_lag + L.LAG_COLS * Lb
+    assert L.LAG_COLS == 6  # rep, row, donor, dexec, dstat, lexec
     assert L.o_miss == L.o_resp + E
 
     s = st.create_groups(st.init_state(R, G, W),

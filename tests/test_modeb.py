@@ -341,27 +341,34 @@ def test_ckpt_donation_consistent_under_pipelined_tick(tmp_path):
         node.close()
 
 
-@pytest.mark.parametrize("seed", [1, 4, 9])
-def test_random_kill_restart_released_writes_converge(tmp_path, seed):
-    """Randomized Mode B durability: random commits at random nodes under
-    random single-node deaths + journal restarts (majority always alive,
-    backlogs dropped on outage) — every response RELEASED to a client must
-    converge onto every node's app.  The per-process twin of the Mode A
-    crash/recover property (tests/test_safety_random.py)."""
+def run_random_kill_restart(tmp_path, seed, cfg=None, steps=30):
+    """Randomized Mode B durability property: random commits at random nodes
+    under random single-node deaths + journal restarts (majority always
+    alive, backlogs dropped on outage) — every response RELEASED to a client
+    must converge onto every node's app.  The per-process twin of the Mode A
+    crash/recover property (tests/test_safety_random.py).
+
+    Reused by the digest soak (tests/test_digest_soak.py), which runs it
+    with ``cfg.paxos.digest_accepts = True`` across a seed sweep.  Returns a
+    stats dict so the soak can commit its artifact."""
     rng = np.random.default_rng(seed)
-    cl = Cluster(make_cfg(window=4), wal_root=tmp_path)
+    cl = Cluster(cfg if cfg is not None else make_cfg(window=4),
+                 wal_root=tmp_path)
     pending = {}  # key -> (value, done-list); folded into released at end
     dead = None
+    kills = restarts = 0
     try:
         cl.create("svc")
         n = 0
-        for step in range(30):
+        for step in range(steps):
             if dead is None and rng.random() < 0.2:
                 dead = IDS[int(rng.integers(0, 3))]
                 cl.kill(dead)
+                kills += 1
             elif dead is not None and rng.random() < 0.4:
                 cl.drop_backlog(dead)
                 cl.restart(dead)
+                restarts += 1
                 dead = None
             at = str(rng.choice([i for i in IDS if i != dead]))
             n += 1
@@ -380,6 +387,7 @@ def test_random_kill_restart_released_writes_converge(tmp_path, seed):
         if dead is not None:
             cl.drop_backlog(dead)
             cl.restart(dead)
+            restarts += 1
 
         def released():
             # late releases count: a response that fired after its
@@ -401,5 +409,22 @@ def test_random_kill_restart_released_writes_converge(tmp_path, seed):
             assert not missing, (nid, len(missing), dict(
                 list(missing.items())[:4]))
         assert rel  # the run must have exercised something
+        return {
+            "seed": int(seed),
+            "steps": int(steps),
+            "proposed": len(pending),
+            "released": len(rel),
+            "kills": kills,
+            "restarts": restarts,
+            "undigest_fills": sum(
+                node.stats.get("undigest_fills", 0)
+                for node in cl.nodes.values()
+            ),
+        }
     finally:
         cl.close()
+
+
+@pytest.mark.parametrize("seed", [1, 4, 9])
+def test_random_kill_restart_released_writes_converge(tmp_path, seed):
+    run_random_kill_restart(tmp_path, seed)

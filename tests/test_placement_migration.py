@@ -218,7 +218,8 @@ def test_rebalancer_closes_skew_end_to_end(tmp_path):
     assert np.argmax(loads_before) == 0  # every name was created in shard 0
 
     plan = reb.propose(m.tick_num, demand,
-                       free_rows_in_shard=m.free_rows_in_shard)
+                       free_rows_in_shard=m.free_rows_in_shard,
+                       blob_bytes=m.blob_bytes_of_row)
     assert plan and len(plan.moves) >= 1
     moved = mig.execute_plan(plan, pump=m.tick)
     assert moved >= 1

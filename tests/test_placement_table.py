@@ -141,6 +141,50 @@ def test_rebalancer_moves_hottest_group_and_respects_capacity():
     assert not plan2
 
 
+def test_rebalancer_prefers_light_blob_among_equally_hot():
+    """With a ``blob_bytes`` estimator, an equally hot group with a HEAVY
+    checkpoint blob is passed over for the light one: either move sheds the
+    same load, but the light one transfers a fraction of the bytes."""
+    reb = ShardRebalancer(16, 4, skew_threshold=2.0, min_interval_ticks=0,
+                          max_moves_per_plan=1)
+    demand = np.ones(16)
+    demand[0] = 10.0  # row 0: heavy-state group
+    demand[1] = 10.0  # row 1: equally hot, light-state
+    blobs = {0: 1 << 20, 1: 1 << 10}
+
+    plan = reb.propose(0, demand, flat_free,
+                       blob_bytes=lambda row: blobs.get(row, 1 << 10))
+    assert plan and plan.moves[0][0] == 1, plan.moves
+    assert plan.skew_predicted < plan.skew_before
+
+    # near-ties inside the tolerance band count as equally hot too
+    reb2 = ShardRebalancer(16, 4, skew_threshold=2.0, min_interval_ticks=0,
+                           max_moves_per_plan=1, blob_tolerance=0.9)
+    demand2 = np.ones(16)
+    demand2[0] = 10.0
+    demand2[1] = 9.5  # within 10% of the top row
+    plan2 = reb2.propose(0, demand2, flat_free,
+                         blob_bytes=lambda row: blobs.get(row, 1 << 10))
+    assert plan2 and plan2.moves[0][0] == 1, plan2.moves
+
+    # a DECISIVELY hotter heavy group is still the one shed: the tolerance
+    # bounds the heat sacrificed, it does not let bytes override load
+    reb3 = ShardRebalancer(16, 4, skew_threshold=2.0, min_interval_ticks=0,
+                           max_moves_per_plan=1)
+    demand3 = np.ones(16)
+    demand3[0] = 10.0
+    demand3[1] = 5.0
+    plan3 = reb3.propose(0, demand3, flat_free,
+                         blob_bytes=lambda row: blobs.get(row, 1 << 10))
+    assert plan3 and plan3.moves[0][0] == 0, plan3.moves
+
+    # without an estimator, behavior is unchanged (index-order argmax)
+    reb4 = ShardRebalancer(16, 4, skew_threshold=2.0, min_interval_ticks=0,
+                           max_moves_per_plan=1)
+    plan4 = reb4.propose(0, demand, flat_free)
+    assert plan4 and plan4.moves[0][0] == 0, plan4.moves
+
+
 def test_rebalancer_hysteresis_and_min_interval():
     reb = ShardRebalancer(16, 4, skew_threshold=2.0, hysteresis=1.25,
                           min_interval_ticks=10)

@@ -13,6 +13,11 @@ import pytest
 
 from gigapaxos_tpu.net.messenger import Messenger, NodeMap
 from gigapaxos_tpu.net.security import SSLMode, TransportSecurity
+
+# testing.certs mints a real CA with the cryptography package, which the
+# runtime stack never needs — skip collection cleanly where it is absent
+pytest.importorskip("cryptography")
+
 from gigapaxos_tpu.testing.certs import make_test_ca
 
 

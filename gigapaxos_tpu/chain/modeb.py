@@ -58,6 +58,9 @@ from .tick import ChainInbox, chain_tick_impl
 
 #: chain frame schema (shared SoA codec, distinct magic)
 CH_MAGIC = b"GPXC"
+#: chain's own frame-batch container magic (bytes-handler prefix dispatch
+#: must stay unambiguous when paxos Mode B coexists on the messenger)
+CH_BATCH_MAGIC = b"GPXD"
 CH_SCALARS = ("applied", "status", "next_slot")
 CH_RINGS = ("c_req", "c_slot")
 CH_BITS = ("c_stop",)
@@ -291,7 +294,18 @@ class ChainModeBNode(ModeBCommon):
         prev = d.bytes_handler
 
         def on_bytes(sender: str, payload: bytes) -> None:
-            if payload.startswith(CH_MAGIC):
+            if payload.startswith(CH_BATCH_MAGIC):
+                # split the per-(peer, tick) container; each sub-frame is
+                # journaled/applied like a singly-sent frame (WAL replay
+                # format unchanged)
+                try:
+                    subs = wire.decode_frames(payload, magic=CH_BATCH_MAGIC)
+                except (ValueError, struct.error):
+                    self.stats["bad_frames"] += 1
+                    return
+                for sub in subs:
+                    self._on_frame(sender, sub)
+            elif payload.startswith(CH_MAGIC):
                 self._on_frame(sender, payload)
             elif prev is not None:
                 prev(sender, payload)
@@ -493,11 +507,14 @@ class ChainModeBNode(ModeBCommon):
             if self.tick_num % 64 == 0:
                 self._sweep()
         if frames and self.m is not None:
+            # identical frame list for every peer: one container, one
+            # transport frame (and one writev) per peer per tick
+            batch = (wire.encode_frames(frames, magic=CH_BATCH_MAGIC)
+                     if len(frames) > 1 else frames[0])
             for i, peer in enumerate(self.members):
                 if i != self.r:
                     try:
-                        for frame in frames:
-                            self.m.send_bytes(peer, frame)
+                        self.m.send_bytes(peer, batch)
                     except SendFailure:
                         self.stats["send_failures"] += 1
         return out

@@ -47,11 +47,17 @@ class ReconfigurableAppClient:
         actives_ttl_s: float = 30.0,
         explore_prob: float = 0.1,
         security=None,
+        placement_table=None,
     ):
         """``security``: a ``TransportSecurity`` for TLS deployments — under
         MUTUAL_AUTH it must carry a CA-signed client certificate (the
         reference's mutual-auth client types,
-        ReconfigurableAppClientAsync.java:35)."""
+        ReconfigurableAppClientAsync.java:35).
+
+        ``placement_table``: an optional ``placement.PlacementTable`` fed by
+        the deployment wiring (the http_edge idiom).  When present, names
+        with a migration override route straight to the override's server —
+        the actives cache and RC never need to chase the placement."""
         self.node_id = client_id or f"C{uuid.uuid4().hex[:8]}"
         self.nodemap = NodeMap(nodes)
         self.m = Messenger(self.node_id, (bind_host, 0), self.nodemap,
@@ -63,6 +69,7 @@ class ReconfigurableAppClient:
         self._rc_rr = itertools.cycle(self.rc_ids)
         self.actives_ttl_s = actives_ttl_s
         self.explore_prob = explore_prob
+        self.placement_table = placement_table
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._next_rid = random.randrange(1, 1 << 30)
@@ -310,6 +317,28 @@ class ReconfigurableAppClient:
         return list(actives)
 
     # ----------------------------------------------------------- app requests
+    def attach_placement(self, table) -> None:
+        """Wire a ``PlacementTable`` after construction (deployment wiring
+        may build the client before the table exists)."""
+        self.placement_table = table
+
+    def _route(self, name: str, actives: List[str], avoid=()) -> str:
+        """Placement-table answer when present, RC answer otherwise.
+
+        A name with a migration override routes to the override's server
+        even when it is not (yet) in the cached actives list — the table is
+        newer truth than the RC answer, so a migrated group's requests reach
+        the new shard without an RC round-trip.  Names without an override
+        (and overrides whose server has already failed this request) fall
+        through to the RTT redirector over the RC's actives."""
+        t = self.placement_table
+        if t is not None:
+            lead = t.lead_server(name)
+            if (lead is not None and lead not in avoid
+                    and (lead in actives or self.nodemap(lead) is not None)):
+                return lead
+        return self._pick_active(actives, avoid)
+
     def _pick_active(self, actives: List[str], avoid=()) -> str:
         """Lowest-EWMA-RTT active, with epsilon exploration so a recovered
         replica gets re-measured (E2ELatencyAwareRedirector's probe idea).
@@ -331,7 +360,7 @@ class ReconfigurableAppClient:
     ) -> int:
         """Fire one app request; the callback gets the raw response packet
         (``ok``/``response``/``error``).  Actives must be resolvable."""
-        target = active or self._pick_active(self.request_actives(name))
+        target = active or self._route(name, self.request_actives(name))
         rid = self._rid()
         now = time.monotonic()
         with self._lock:
@@ -389,7 +418,7 @@ class ReconfigurableAppClient:
         for name, payload in items:
             target = active or target_of.get(name)
             if target is None:
-                target = self._pick_active(self.request_actives(name))
+                target = self._route(name, self.request_actives(name))
                 target_of[name] = target
             rid = self._rid()
             rids.append(rid)
@@ -465,7 +494,7 @@ class ReconfigurableAppClient:
                     actives = self.request_actives(name, force=attempt > 0)
                 except ClientError as e:
                     raise ClientError(f"{name}: {e}") from e
-                target = self._pick_active(actives, avoid=bad)
+                target = self._route(name, actives, avoid=bad)
                 with self._lock:
                     self._sent_at[rid] = (target, time.monotonic())
                 self.m.send(

@@ -245,7 +245,18 @@ class ModeBNode(ModeBCommon):
         prev = d.bytes_handler
 
         def on_bytes(sender: str, payload: bytes) -> None:
-            if payload.startswith(wire.MAGIC):
+            if payload.startswith(wire.BATCH_MAGIC):
+                # per-(peer, tick) container: split and journal/apply each
+                # sub-frame individually, so WAL replay sees exactly the
+                # records a singly-sent stream would have produced
+                try:
+                    subs = wire.decode_frames(payload)
+                except (ValueError, struct.error):
+                    self.stats["bad_frames"] += 1
+                    return
+                for sub in subs:
+                    self._on_frame(sender, sub)
+            elif payload.startswith(wire.MAGIC):
                 self._on_frame(sender, payload)
             elif prev is not None:
                 prev(sender, payload)
@@ -790,11 +801,16 @@ class ModeBNode(ModeBCommon):
             self.stats["frame_bytes_sent"] += sum(map(len, frames)) * (
                 len(self.members) - 1
             )
+            # the frame list is identical for every peer: pack it ONCE into
+            # one contiguous container, so the whole per-(peer, tick)
+            # fan-out is a single transport frame per peer (and the writer
+            # drains it in a single writev)
+            batch = (wire.encode_frames(frames) if len(frames) > 1
+                     else frames[0])
             for i, peer in enumerate(self.members):
                 if i != self.r:
                     try:
-                        for frame in frames:
-                            self.m.send_bytes(peer, frame)
+                        self.m.send_bytes(peer, batch)
                     except SendFailure:
                         # transport closing underneath a final tick — the
                         # anti-entropy full frame re-ships state anyway

@@ -37,10 +37,20 @@ Layout (little-endian):
            i32 {ringbits}[n]  -- acc_stop,dec_valid,dec_stop,prop_valid,
                                  prop_stop packed 5*W bits? no: one i32 per
                                  ring-bit field per group (W<=31 bits each)
-  payload table: n_payload x (i32 rid | u8 stop | u32 len | bytes)
+  payload table: i32 rid[n_payload] | u8 stop[n_payload] | u32 len[n_payload]
+                 | concatenated payload bytes
 
-Everything but the payload table encodes/decodes as vectorized numpy
-``tobytes``/``frombuffer`` — no per-group Python work.
+Everything — including the payload table since v2 — encodes/decodes as
+vectorized numpy ``tobytes``/``frombuffer`` column slabs: payload byte
+ranges come from one ``cumsum`` over the length column, never a per-request
+``struct`` loop (v1 interleaved ``(rid,stop,len,bytes)`` records decode for
+journal replay of frames written before the column switch).
+
+Many frames bound for the same peer in one tick pack into a single
+contiguous buffer via ``encode_frames``/``decode_frames`` (the
+PaxosPacketBatcher analog, gigapaxos/PaxosPacketBatcher.java:28-35): one
+batch magic + a length column + the concatenated frames, so the whole
+per-(peer, tick) fan-out is one transport frame and one writev on the wire.
 """
 
 from __future__ import annotations
@@ -52,7 +62,10 @@ from typing import Dict, List, NamedTuple, Tuple
 import numpy as np
 
 MAGIC = b"GPXB"
-VERSION = 1
+#: Frame-batch container magic (chain passes its own so the bytes-handler
+#: prefix dispatch stays unambiguous across coexisting protocols).
+BATCH_MAGIC = b"GPXS"
+VERSION = 2  # v2: columnar payload table; v1 (interleaved) still decodes
 
 FLAG_COORD_ACTIVE = 1
 FLAG_COORD_PREPARING = 2
@@ -67,7 +80,8 @@ RINGS = ("acc_bnum", "acc_bcoord", "acc_req", "acc_slot",
 RING_BITS = ("acc_stop", "dec_valid", "dec_stop", "prop_valid", "prop_stop")
 
 _HDR = struct.Struct("<4sHHiqBii")
-_PAY = struct.Struct("<iBI")
+_PAY = struct.Struct("<iBI")  # v1 interleaved payload record (decode only)
+_BHDR = struct.Struct("<4sI")  # batch container: magic, frame count
 
 
 def gid_of(name: str) -> int:
@@ -141,9 +155,15 @@ def encode_frame(
         parts.append(np.ascontiguousarray(rings[f], np.int32).tobytes())
     for f in bit_fields:
         parts.append(pack_bits(ring_bits[f]).tobytes())
-    for rid, stop, data in payloads:
-        parts.append(_PAY.pack(rid, int(stop), len(data)))
-        parts.append(data)
+    n_pay = len(payloads)
+    if n_pay:
+        parts.append(np.fromiter(
+            (p[0] for p in payloads), np.int32, n_pay).tobytes())
+        parts.append(np.fromiter(
+            (p[1] for p in payloads), np.uint8, n_pay).tobytes())
+        parts.append(np.fromiter(
+            (len(p[2]) for p in payloads), np.uint32, n_pay).tobytes())
+        parts.extend(p[2] for p in payloads)
     return b"".join(parts)
 
 
@@ -155,7 +175,7 @@ def decode_frame(
     magic: bytes = MAGIC,
 ) -> Frame:
     hmagic, ver, W, sender_r, tick, full, n, n_pay = _HDR.unpack_from(buf, 0)
-    if hmagic != magic or ver != VERSION:
+    if hmagic != magic or ver not in (1, VERSION):
         raise ValueError("bad replica frame header")
     off = _HDR.size
 
@@ -173,10 +193,52 @@ def decode_frame(
     rings = {f: col(np.int32, n * W).reshape(n, W) for f in ring_fields}
     ring_bits = {f: unpack_bits(col(np.int32, n), W) for f in bit_fields}
     payloads: List[Tuple[int, bool, bytes]] = []
-    for _ in range(n_pay):
-        rid, stop, ln = _PAY.unpack_from(buf, off)
-        off += _PAY.size
-        payloads.append((rid, bool(stop), buf[off: off + ln]))
-        off += ln
+    if ver == 1:
+        # journal-replay compatibility: interleaved per-request records
+        for _ in range(n_pay):
+            rid, stop, ln = _PAY.unpack_from(buf, off)
+            off += _PAY.size
+            payloads.append((rid, bool(stop), buf[off: off + ln]))
+            off += ln
+    elif n_pay:
+        rids = col(np.int32, n_pay).tolist()
+        stops = (col(np.uint8, n_pay) != 0).tolist()
+        ends = np.cumsum(col(np.uint32, n_pay).astype(np.int64)) + off
+        starts = np.empty(n_pay, np.int64)
+        starts[0] = off
+        starts[1:] = ends[:-1]
+        mv = memoryview(buf)
+        payloads = [
+            (rid, stop, bytes(mv[s:e]))
+            for rid, stop, s, e in zip(rids, stops, starts.tolist(),
+                                       ends.tolist())
+        ]
     return Frame(sender_r, tick, W, bool(full), gids, scalars, flags, digest,
                  rings, ring_bits, payloads)
+
+
+# ------------------------------------------------------------- frame batches
+def encode_frames(frames: List[bytes], magic: bytes = BATCH_MAGIC) -> bytes:
+    """Pack already-encoded frames into one contiguous buffer: all frames a
+    node emits toward one peer in a tick travel as a single transport frame
+    (and a single writev on the wire)."""
+    k = len(frames)
+    lens = np.fromiter((len(f) for f in frames), np.uint32, k)
+    return b"".join([_BHDR.pack(magic, k), lens.tobytes(), *frames])
+
+
+def decode_frames(buf: bytes, magic: bytes = BATCH_MAGIC) -> List[bytes]:
+    """Split a batch container back into its frames (bytes copies, so each
+    sub-frame can be journaled raw exactly like a singly-sent frame)."""
+    hmagic, k = _BHDR.unpack_from(buf, 0)
+    if hmagic != magic:
+        raise ValueError("bad frame-batch header")
+    off = _BHDR.size
+    lens = np.frombuffer(buf, np.uint32, k, off).astype(np.int64)
+    off += 4 * k
+    ends = np.cumsum(lens) + off
+    starts = ends - lens
+    if k and int(ends[-1]) != len(buf):
+        raise ValueError("frame-batch length mismatch")
+    mv = memoryview(buf)
+    return [bytes(mv[s:e]) for s, e in zip(starts.tolist(), ends.tolist())]

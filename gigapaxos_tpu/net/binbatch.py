@@ -22,12 +22,58 @@ Response frame (active -> client):
 from __future__ import annotations
 
 import struct
+import threading
 from typing import List, Tuple
 
 import numpy as np
 
+from .transport import SendFailure
+
 REQ_MAGIC = b"GBR1"
 RESP_MAGIC = b"GBS1"
+
+
+class ClientEgress:
+    """Per-(client, tick) coalescing of response frames.
+
+    The manager's callback flush releases every durable completion of a tick
+    in one loop; each finished bid builds one response frame.  Inside an open
+    scope (the flushing thread brackets the loop) frames stage per client and
+    leave as ONE ``send_bytes_many`` list — a single generation stamp, a
+    single writev.  Off-scope emits (dedup resends, admission-thread rejects)
+    send immediately.  Scopes are thread-local so completions delivered on
+    other threads never stall behind an open scope."""
+
+    def __init__(self, messenger):
+        self.m = messenger
+        self._tl = threading.local()
+
+    def open_scope(self):
+        """Begin staging on this thread; returns the close-and-flush call."""
+        self._tl.buf = {}
+
+        def close() -> None:
+            buf = self._tl.__dict__.pop("buf", None)
+            if not buf:
+                return
+            for client, frames in buf.items():
+                try:
+                    self.m.send_bytes_many(client, frames)
+                except SendFailure:
+                    # transport closing: responses are simply undeliverable
+                    pass
+
+        return close
+
+    def emit(self, client: str, frame: bytes) -> None:
+        buf = getattr(self._tl, "buf", None)
+        if buf is not None:
+            buf.setdefault(client, []).append(frame)
+            return
+        try:
+            self.m.send_bytes(client, frame)
+        except SendFailure:
+            pass
 
 
 def encode_request(bid: int, host: str, port: int, client_id: str,

@@ -11,10 +11,11 @@ protocol-task restarts — so the messenger itself stays stateless.
 
 from __future__ import annotations
 
+import json
 from typing import Dict, Iterable, Optional, Tuple
 
 from ..config import NodeConfig
-from .transport import JsonDemux, Transport
+from .transport import KIND_JSON, JsonDemux, Transport
 
 
 class NodeMap:
@@ -71,13 +72,21 @@ class Messenger:
         self.transport.send(dest, packet)
 
     def multicast(self, dests: Iterable[str], packet: dict) -> None:
+        # serialize ONCE and fan the same byte buffer to every destination
+        # (GenericMessagingTask sends one marshalled packet to a node set)
         packet.setdefault("sender", self.node_id)
+        data = json.dumps(packet).encode()
         for d in dests:
             if d is not None:
-                self.transport.send(d, dict(packet))
+                self.transport.send_raw(d, KIND_JSON, data)
 
     def send_bytes(self, dest: str, payload: bytes) -> None:
         self.transport.send_bytes(dest, payload)
+
+    def send_bytes_many(self, dest: str, payloads) -> None:
+        """A tick's frame list for one peer: stamped under one transport
+        generation so the writer can drain them in a single writev."""
+        self.transport.send_bytes_many(dest, payloads)
 
     def close(self) -> None:
         self.transport.close()

@@ -57,6 +57,41 @@ def _send_frame(sock: socket.socket, kind: int, payload: bytes) -> None:
     sock.sendall(_HDR.pack(len(payload) + 1, kind) + payload)
 
 
+#: Linux caps one sendmsg at IOV_MAX (1024) iovecs; each frame contributes
+#: two (header, payload).
+_IOV_MAX = 1024
+
+
+def _send_frames(sock: socket.socket, batch) -> int:
+    """Write every ``(gen, kind, payload)`` frame in ``batch`` with as few
+    syscalls as the iovec limit allows (writev via ``sendmsg``); returns the
+    syscall count.  Partial sends resume mid-buffer; TLS sockets have no
+    usable ``sendmsg`` so they fall back to one coalesced ``sendall``."""
+    bufs = []
+    for _gen, kind, payload in batch:
+        bufs.append(_HDR.pack(len(payload) + 1, kind))
+        bufs.append(payload)
+    if isinstance(sock, _ssl.SSLSocket):
+        sock.sendall(b"".join(bufs))
+        return 1
+    # empty payloads contribute nothing and would stall the resume loop
+    views = [memoryview(b) for b in bufs if len(b)]
+    syscalls = 0
+    i = 0
+    while i < len(views):
+        sent = sock.sendmsg(views[i: i + _IOV_MAX])
+        syscalls += 1
+        while sent > 0:
+            ln = len(views[i])
+            if sent >= ln:
+                sent -= ln
+                i += 1
+            else:
+                views[i] = views[i][sent:]
+                sent = 0
+    return syscalls
+
+
 #: Receive-buffer chunk: one recv() this size slices dozens-to-thousands of
 #: control-plane frames (typical frame: tens of bytes) out of kernel space
 #: in a single syscall.
@@ -139,6 +174,7 @@ class _Peer:
         #: wholly after (stamped fresh, survives)
         self.gen = 0
         self.glock = threading.Lock()
+        self._carry = None  # writer-owned: see _drain_batch
         self.thread = threading.Thread(
             target=self._run, name=f"tx-{transport.node_id}->{dest}", daemon=True
         )
@@ -165,28 +201,54 @@ class _Peer:
                           "connect_failures")
             return None
 
+    def _drain_batch(self, first) -> list:
+        """Coalesce queued frames behind ``first`` into one writev batch,
+        bounded by the coalescing window and — critically — generation
+        homogeneity: the first frame stamped with a different generation
+        ends the batch and is carried into the next one, so a single
+        ``sendmsg`` can never interleave frames across a ``reset_peer``."""
+        batch = [first]
+        nbytes = len(first[2])
+        while (len(batch) < self.t.coalesce_frames
+               and nbytes < self.t.coalesce_bytes):
+            try:
+                nxt = self.q.get_nowait()
+            except queue.Empty:
+                break
+            if nxt[0] != first[0]:
+                self._carry = nxt
+                break
+            batch.append(nxt)
+            nbytes += len(nxt[2])
+        return batch
+
     def _run(self) -> None:
         backoff = 0.05
         while not self.t.closed:
-            try:
-                gen, kind, payload = self.q.get(timeout=0.25)
-            except queue.Empty:
-                continue
-            # retry the same frame across reconnects until sent or give up
+            if self._carry is not None:
+                first, self._carry = self._carry, None
+            else:
+                try:
+                    first = self.q.get(timeout=0.25)
+                except queue.Empty:
+                    continue
+            batch = self._drain_batch(first)
+            gen = first[0]
+            # retry the same batch across reconnects until sent or give up
             attempts = 0
             while not self.t.closed:
                 if self.gen != gen:
-                    # peer was reset while this frame was in hand: a frame
+                    # peer was reset while this batch was in hand: frames
                     # queued before the reset must never reach a peer that
                     # reconnected after it
-                    self.t._count("reset_drops")
+                    self.t._count("reset_drops", len(batch))
                     break
                 if self.sock is None:
                     self.sock = self._connect()
                     if self.sock is None:
                         attempts += 1
                         if attempts > self.t.max_connect_attempts:
-                            self.t._count("dropped")
+                            self.t._count("dropped", len(batch))
                             break
                         time.sleep(min(backoff * (2 ** attempts), 2.0))
                         continue
@@ -194,19 +256,20 @@ class _Peer:
                 if self.gen != gen:
                     # reset landed while _connect was blocking: the new
                     # socket may already be the peer's NEXT incarnation,
-                    # which must not see this pre-reset frame
-                    self.t._count("reset_drops")
+                    # which must not see these pre-reset frames
+                    self.t._count("reset_drops", len(batch))
                     break
                 try:
-                    _send_frame(self.sock, kind, payload)
-                    self.t._count("sent")
+                    n_sys = _send_frames(self.sock, batch)
+                    self.t._count("sent", len(batch))
+                    self.t._count("send_syscalls", n_sys)
                     break
                 except (OSError, struct.error):
                     try:
                         self.sock.close()
                     except OSError:
                         pass
-                    self.sock = None  # reconnect and retry this frame
+                    self.sock = None  # reconnect and retry this batch
 
     def close(self) -> None:
         s = self.sock  # snapshot: the writer nulls this field concurrently
@@ -239,6 +302,8 @@ class Transport:
         connect_timeout_s: float = 2.0,
         max_connect_attempts: int = 5,
         security: Optional[TransportSecurity] = None,
+        coalesce_frames: int = _IOV_MAX // 2,
+        coalesce_bytes: int = 8 * 1024 * 1024,
     ):
         self.node_id = node_id
         self.demux = demux
@@ -246,6 +311,12 @@ class Transport:
         self.send_queue_cap = send_queue_cap
         self.connect_timeout_s = connect_timeout_s
         self.max_connect_attempts = max_connect_attempts
+        #: bounded coalescing window per writev batch: at most this many
+        #: frames (each is 2 iovecs) and roughly this many payload bytes
+        #: leave in one drain, so one flooded peer cannot pin the writer in
+        #: a single giant send while a reset is pending
+        self.coalesce_frames = max(1, coalesce_frames)
+        self.coalesce_bytes = max(1, coalesce_bytes)
         self.security = security
         self.server_ssl_ctx = (
             security.server_context() if security is not None else None
@@ -275,38 +346,54 @@ class Transport:
     def send_bytes(self, dest: str, payload: bytes) -> None:
         self.send_raw(dest, KIND_BYTES, payload)
 
+    def send_bytes_many(self, dest: str, payloads) -> None:
+        self.send_raw_many(dest, KIND_BYTES, payloads)
+
     def send_raw(self, dest: str, kind: int, payload: bytes) -> None:
+        self.send_raw_many(dest, kind, (payload,))
+
+    def send_raw_many(self, dest: str, kind: int, payloads) -> None:
+        """Enqueue a tick's worth of frames for ``dest`` under ONE generation
+        stamp, so the writer's coalescing drain can put them all in a single
+        ``writev`` (frame-at-a-time callers go through here too — a
+        one-element list)."""
         if self.closed:
             raise SendFailure("transport closed")
-        if len(payload) > MAX_FRAME:
-            # fail loudly at the sender — the receiver would drop the whole
-            # connection; big state must go through checkpoint chunking
-            raise SendFailure(
-                f"frame of {len(payload)}B exceeds MAX_FRAME={MAX_FRAME}"
-            )
+        for payload in payloads:
+            if len(payload) > MAX_FRAME:
+                # fail loudly at the sender — the receiver would drop the
+                # whole connection; big state goes through checkpoint
+                # chunking
+                raise SendFailure(
+                    f"frame of {len(payload)}B exceeds MAX_FRAME={MAX_FRAME}"
+                )
         if dest == self.node_id:
             # loopback short-circuit: no socket, no serialization round-trip
             # beyond the bytes already built (keeps ordering with real sends
             # unnecessary — the reference short-circuits identically)
-            self._count("loopback")
-            try:
-                self.demux(self.node_id, kind, payload)
-            except Exception:
-                # same contract as the socket read path: handler bugs are
-                # counted, not propagated into the sender
-                self._count("demux_errors")
+            for payload in payloads:
+                self._count("loopback")
+                try:
+                    self.demux(self.node_id, kind, payload)
+                except Exception:
+                    # same contract as the socket read path: handler bugs are
+                    # counted, not propagated into the sender
+                    self._count("demux_errors")
             return
         with self._plock:
             peer = self._peers.get(dest)
             if peer is None:
                 peer = self._peers[dest] = _Peer(self, dest)
-        try:
-            with peer.glock:
-                peer.q.put_nowait((peer.gen, kind, payload))
-        except queue.Full:
-            # backpressure: drop-newest, callers with liveness needs retry via
-            # protocol tasks (congestion handling, PaxosManager.java:920-935)
-            self._count("backpressure_drop")
+        with peer.glock:
+            gen = peer.gen
+            for payload in payloads:
+                try:
+                    peer.q.put_nowait((gen, kind, payload))
+                except queue.Full:
+                    # backpressure: drop-newest, callers with liveness needs
+                    # retry via protocol tasks (congestion handling,
+                    # PaxosManager.java:920-935)
+                    self._count("backpressure_drop")
 
     # ---------------------------------------------------------------- receive
     def _accept_loop(self) -> None:

@@ -25,6 +25,8 @@ objects are unchanged — only the manager's mesh placement differs.
 
 from __future__ import annotations
 
+import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 from .config import GigapaxosTpuConfig
@@ -33,6 +35,7 @@ from .net.failure_detection import FailureDetection
 from .net.messenger import Messenger, NodeMap
 from .paxos.driver import TickDriver
 from .paxos.manager import PaxosManager
+from .placement import GroupMigrator, MigrationStats, ShardRebalancer
 from .reconfiguration.active_replica import ActiveReplica
 from .reconfiguration.coordinator import PaxosReplicaCoordinator
 from .reconfiguration.demand import AbstractDemandProfile, DemandProfile
@@ -41,6 +44,84 @@ from .reconfiguration.rc_db import (
     RepliconfigurableReconfiguratorDB,
 )
 from .reconfiguration.reconfigurator import Reconfigurator
+
+
+class RebalancerDaemon:
+    """Periodic placement loop: ``ShardRebalancer.propose`` over the live
+    demand snapshot, ``GroupMigrator.execute_plan`` through the epoch
+    machinery (ROADMAP placement follow-up — callers no longer drive the
+    loop by hand).  OFF by default: started only by an explicit
+    :meth:`InProcessCluster.start_rebalancer`; its lifecycle is tied to the
+    node (``close()`` stops it)."""
+
+    def __init__(self, cluster: "InProcessCluster", interval_s: float = 1.0,
+                 *, table=None, stats: Optional[MigrationStats] = None,
+                 migrator: Optional[GroupMigrator] = None,
+                 rebalancer: Optional[ShardRebalancer] = None,
+                 **rebalancer_kw):
+        m = cluster.manager
+        if getattr(m, "_placement", None) is None:
+            raise RuntimeError(
+                "rebalancer daemon needs cfg.placement.enabled demand "
+                "counters on the data-plane manager"
+            )
+        gs, _per = m.shard_geometry()
+        self.m = m
+        self.driver = cluster.driver
+        self.interval_s = float(interval_s)
+        self.stats = stats or MigrationStats()
+        self.migrator = migrator or GroupMigrator(
+            cluster.coordinator, table=table, counters=m._placement,
+            stats=self.stats,
+        )
+        self.rebalancer = rebalancer or ShardRebalancer(
+            m.G, gs, **rebalancer_kw
+        )
+        self.moves_total = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="rebalancer", daemon=True
+        )
+        self._thread.start()
+
+    def _pump(self) -> None:
+        # the TickDriver owns the tick loop; the migrator just needs the
+        # plane to advance while it waits for the stop/checkpoint to land
+        self.driver.kick()
+        time.sleep(0.002)
+
+    def run_once(self) -> int:
+        """One propose/execute round; returns groups moved."""
+        demand = self.m.demand_snapshot()
+        if demand is None:
+            return 0
+        plan = self.rebalancer.propose(
+            self.m.tick_num, demand,
+            free_rows_in_shard=self.m.free_rows_in_shard,
+            blob_bytes=self.m.blob_bytes_of_row,
+        )
+        if not plan:
+            return 0
+        moved = self.migrator.execute_plan(plan, pump=self._pump)
+        if moved:
+            self.rebalancer.record_executed(moved)
+        else:
+            self.rebalancer.record_aborted()
+        self.moves_total += moved
+        return moved
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.run_once()
+            except Exception:
+                # a transient failure (shutdown race, full destination)
+                # must not kill the daemon; the next round re-plans
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
 
 
 class InProcessCluster:
@@ -124,6 +205,7 @@ class InProcessCluster:
         self.actives: Dict[str, ActiveReplica] = {}
         self.reconfigurators: Dict[str, Reconfigurator] = {}
         self.fds: Dict[str, FailureDetection] = {}
+        self.rebalancer: Optional[RebalancerDaemon] = None
         self._liveness: Dict[str, bool] = {n: True for n in rc_ids + active_ids}
 
         for a in active_ids:
@@ -241,6 +323,23 @@ class InProcessCluster:
         self.cfg.nodes.reconfigurators.pop(node_id, None)
         self._liveness[node_id] = False
 
+    # ------------------------------------------------------------- placement
+    def start_rebalancer(self, interval_s: float = 1.0,
+                         **kw) -> RebalancerDaemon:
+        """Start the periodic rebalancer (off by default).  ``kw`` passes
+        through to :class:`RebalancerDaemon` — ``table=`` to keep a
+        placement-override table in step with moves, plus any
+        :class:`ShardRebalancer` tuning (``skew_threshold``, ...)."""
+        if self.rebalancer is not None:
+            raise RuntimeError("rebalancer already running")
+        self.rebalancer = RebalancerDaemon(self, interval_s, **kw)
+        return self.rebalancer
+
+    def stop_rebalancer(self) -> None:
+        if self.rebalancer is not None:
+            self.rebalancer.stop()
+            self.rebalancer = None
+
     # ----------------------------------------------------------------- admin
     def kick(self) -> None:
         self.driver.kick()
@@ -252,6 +351,7 @@ class InProcessCluster:
         self._liveness[node] = up
 
     def close(self) -> None:
+        self.stop_rebalancer()
         for fd in self.fds.values():
             fd.close()
         for ar in self.actives.values():

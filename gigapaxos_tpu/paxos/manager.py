@@ -98,6 +98,11 @@ class PaxosManager:
         # (log-before-respond, the analog of logAndMessage's log-before-send,
         # AbstractPaxosLogger.java:157-178)
         self._held_callbacks: list = []
+        # egress coalescing scopes bracketing each callback flush: hooks
+        # return a close-callable; the response edge (ActiveReplica's
+        # ClientEgress) uses this to hand the transport per-(client, tick)
+        # frame lists instead of frame-at-a-time sends
+        self._flush_scope_hooks: list = []
         # per (replica, row) dedup of executed request ids (bounded)
         self._seen: Dict[tuple, collections.OrderedDict] = collections.defaultdict(
             collections.OrderedDict
@@ -1617,8 +1622,13 @@ class PaxosManager:
         if self.wal is not None and not self.wal.is_synced():
             return
         held, self._held_callbacks = self._held_callbacks, []
-        for cb, rid, resp in held:
-            cb(rid, resp)
+        closers = [h() for h in self._flush_scope_hooks]
+        try:
+            for cb, rid, resp in held:
+                cb(rid, resp)
+        finally:
+            for c in closers:
+                c()
 
     def _process_outbox(self, out: HostOutbox, placed=None,
                         bulk_placed=None) -> None:

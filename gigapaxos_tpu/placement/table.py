@@ -76,6 +76,13 @@ class PlacementTable:
             return servers
         return [lead] + [s for s in servers if s != lead][: max(k - 1, 0)]
 
+    def lead_server(self, name: str) -> Optional[str]:
+        """The overridden name's new home server — None when the name has
+        no override (route by the ring / RC answer) or the override's shard
+        has no server in this layout."""
+        ov = self.overrides.get(name)
+        return None if ov is None else self._server_of_shard.get(ov)
+
     def order_actives(self, name: str, actives: Sequence[str]) -> List[str]:
         """Reorder an arbitrary server list so an overridden name's new
         home leads (edge routing: DNS answer order / REQ_ACTIVES order).

@@ -176,6 +176,13 @@ class ActiveReplica:
         # binary batched-request frames (SoA wire, net/binbatch.py)
         binbatch.chain_bytes_handler(self.m.demux, binbatch.REQ_MAGIC,
                                      self._on_binary_batch)
+        # response egress coalesced per (client, tick): the manager's
+        # callback flush opens a scope, every bid finished inside it stages,
+        # and each client's frames leave as one generation-stamped list
+        self._egress = binbatch.ClientEgress(self.m)
+        mgr = getattr(coordinator, "manager", None)
+        if mgr is not None and hasattr(mgr, "_flush_scope_hooks"):
+            mgr._flush_scope_hooks.append(self._egress.open_scope)
         # (client, rid) -> None while in flight, response packet once done;
         # absorbs same-rid retransmissions (GCConcurrentHashMap analog)
         self._req_dedup: "collections.OrderedDict[tuple, Optional[dict]]" = (
@@ -474,13 +481,11 @@ class ActiveReplica:
                 else:
                     self._req_dedup.pop(key, None)
                 self._dedup_born.pop(key, None)
-            try:
-                self.m.send_bytes(client_id, frame)
-            except SendFailure:
-                # client/transport gone (shutdown): completions delivered
-                # through the tick thread must never kill the driver;
-                # the response is simply undeliverable
-                pass
+            # in-scope (tick-thread callback flush): staged and sent as one
+            # per-client frame list; off-scope: immediate.  Either way a
+            # closing transport must never kill the driver — the response
+            # is simply undeliverable (ClientEgress swallows SendFailure)
+            self._egress.emit(client_id, frame)
 
         def settle(i: int, ok: bool, body: bytes) -> None:
             statuses[i] = 1 if ok else 0

@@ -1,0 +1,255 @@
+"""Geo soak: region-loss latency SLO over the full Mode B stack on SimNet.
+
+Runs a 3-region WAN topology (``testing.simnet.GEO_TOPOLOGIES``) with one
+Mode B node per region, drives a steady closed-loop workload through three
+phases — before a region loss, during it, after healing — and reports
+p50/p99 commit latency per phase plus time-to-new-coordinator, A/B'd
+between classical full-prepare re-election and consecutive-ballot fast
+re-election (``paxos.fast_reelection``).
+
+All latencies are SIMULATED WAN milliseconds: one SimNet pump round is
+``--ms-per-round`` ms and link delays come from the topology's RTT matrix
+(see PARITY.md — these are not loopback wall-clock numbers and loopback
+RTT is not citable as geo latency).  Every run executes under the chaos
+harness with the per-slot S1 safety ledger asserted.
+
+Usage:
+    python benchmarks/geo_soak.py [--topo us3] [--ticks-per-phase 160]
+        [--every 4] [--ms-per-round 10] [--seed 0] [--out PATH]
+
+Prints one JSON line (the artifact body) on stdout; writes
+``benchmarks/results_geo_soak_pr6.json`` unless ``--out -``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from gigapaxos_tpu.config import GigapaxosTpuConfig  # noqa: E402
+from gigapaxos_tpu.models.replicable import KVApp  # noqa: E402
+from gigapaxos_tpu.modeb import ModeBNode  # noqa: E402
+from gigapaxos_tpu.testing.chaos import (ChaosEvent, ChaosSchedule,  # noqa: E402
+                                         SimChaosRunner)
+from gigapaxos_tpu.testing.simnet import GEO_TOPOLOGIES, SimNet  # noqa: E402
+
+IDS = ["N0", "N1", "N2"]
+
+
+def build_cluster(topo: str, seed: int, fast: bool, ms_per_round: float,
+                  groups: int = 8, window: int = 8):
+    """One node per region (first three regions of the topology)."""
+    net = SimNet(seed=seed)
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = groups
+    cfg.paxos.window = window
+    cfg.paxos.fast_reelection = fast
+    apps = {n: KVApp() for n in IDS}
+    nodes = {n: ModeBNode(cfg, IDS, n, apps[n], net.messenger(n),
+                          anti_entropy_every=8) for n in IDS}
+    regions = list(GEO_TOPOLOGIES[topo]["regions"])[:3]
+    placement = {nid: regions[i] for i, nid in enumerate(IDS)}
+    net.apply_geo(topo, placement, ms_per_round=ms_per_round)
+    for nd in nodes.values():
+        nd.create_group("svc", [0, 1, 2])
+    return net, nodes, apps, placement
+
+
+def percentile(xs, p):
+    return float(np.percentile(np.asarray(xs, dtype=float), p)) if xs else None
+
+
+def soak(topo: str, fast: bool, seed: int, ticks_per_phase: int,
+         every: int, ms_per_round: float, detect_after: int = 8) -> dict:
+    """One full before/during/after run.  Returns phase SLO numbers,
+    time-to-new-coordinator, and the safety ledger summary."""
+    net, nodes, apps, placement = build_cluster(topo, seed, fast,
+                                                ms_per_round)
+    # warm up OUTSIDE the measured window: first election + jit compile +
+    # anti-entropy settling would otherwise pollute the "before" p99
+    warm = []
+    nodes["N0"].propose("svc", b"PUT warm 0", lambda _r, x: warm.append(x))
+    for _ in range(200):
+        for nd in nodes.values():
+            nd.tick()
+        net.pump()
+        if warm:
+            break
+    assert warm == [b"OK"], "warmup commit failed"
+    cut_at = ticks_per_phase
+    heal_at = 2 * ticks_per_phase
+    total = 3 * ticks_per_phase
+    # the workload enters at N1 (a region that stays up); N0's region is
+    # the one lost, and N0 starts as coordinator of every group
+    lost_region = placement["N0"]
+    events = [
+        ChaosEvent(cut_at, "cut_region", {"region": lost_region}),
+        ChaosEvent(cut_at + detect_after, "mark_down", {"node": "N0"}),
+        ChaosEvent(heal_at, "heal_region", {"region": lost_region}),
+        ChaosEvent(heal_at, "mark_up", {"node": "N0"}),
+    ]
+    events += [ChaosEvent(t, "propose",
+                          {"node": "N1", "group": "svc",
+                           "payload": f"PUT k{t} v{t}"})
+               for t in range(2, total, every)]
+    sched = ChaosSchedule(f"geo_soak_{topo}", events, seed=seed)
+    runner = SimChaosRunner(net, nodes, sched)
+
+    row = nodes["N1"].rows.row("svc")
+    takeover = {"tick": None}
+
+    def on_tick(t):
+        if (takeover["tick"] is None and t >= cut_at
+                and int(nodes["N1"]._coord_view[row]) not in (-1, 0)):
+            takeover["tick"] = t
+
+    runner.run(total, on_tick=on_tick)
+    # drain: no new proposals, let in-flight commits land and the healed
+    # region catch up before the convergence check
+    runner.run(ticks_per_phase)
+    runner.ledger.assert_safe()
+
+    phases = {"before": (0, cut_at), "during": (cut_at, heal_at),
+              "after": (heal_at, total)}
+    slo = {}
+    for ph, (lo, hi) in phases.items():
+        lats = [(p["resp_tick"] - p["tick"]) * ms_per_round
+                for p in runner.proposals
+                if p["resp"] == "OK" and lo <= p["tick"] < hi]
+        lost = sum(1 for p in runner.proposals
+                   if p["resp"] is None and lo <= p["tick"] < hi)
+        slo[ph] = {
+            "n": len(lats), "unanswered": lost,
+            "p50_ms": round(percentile(lats, 50), 1) if lats else None,
+            "p90_ms": round(percentile(lats, 90), 1) if lats else None,
+            # tail includes requests in flight when the region died — a
+            # cut-straddling proposal is retried after re-election and
+            # honestly lands in its SEND phase's bucket
+            "p99_ms": round(percentile(lats, 99), 1) if lats else None,
+        }
+    ttc = (None if takeover["tick"] is None
+           else takeover["tick"] - cut_at)
+    return {
+        "fast_reelection": fast,
+        "topology": topo,
+        "lost_region": lost_region,
+        "placement": placement,
+        "ms_per_round": ms_per_round,
+        "detect_after_ticks": detect_after,
+        "slo": slo,
+        "ticks_to_new_coordinator": ttc,
+        "time_to_new_coordinator_ms": (None if ttc is None
+                                       else round(ttc * ms_per_round, 1)),
+        "safety": {"observations": runner.ledger.observations,
+                   "violations": len(runner.ledger.violations)},
+        "dbs_converged": len({json.dumps(a.db, sort_keys=True)
+                              for a in apps.values()}) == 1,
+    }
+
+
+def failover_ab(topo: str, seed: int, ms_per_round: float,
+                detect_after: int = 8) -> dict:
+    """Tight A/B of re-election cost alone: cut the coordinator's region,
+    count ticks until a survivor IS coordinator and until its first
+    post-cut commit — classical prepare vs fast takeover."""
+    out = {}
+    for fast in (False, True):
+        net, nodes, apps, placement = build_cluster(topo, seed, fast,
+                                                    ms_per_round)
+
+        def spin(k, only=None):
+            for _ in range(k):
+                for nid, nd in nodes.items():
+                    if only is None or nid in only:
+                        nd.tick()
+                net.pump()
+
+        done = []
+        nodes["N0"].propose("svc", b"PUT a 1", lambda _r, x: done.append(x))
+        spin(120)
+        assert done == [b"OK"], "warmup commit failed"
+        row = nodes["N1"].rows.row("svc")
+        net.cut_region(placement["N0"])
+        spin(detect_after, only=("N1", "N2"))
+        for nid in ("N1", "N2"):
+            nodes[nid].set_alive(0, False)
+        done2 = []
+        nodes["N1"].propose("svc", b"PUT b 2",
+                            lambda _r, x: done2.append(x))
+        t_coord = t_commit = None
+        for t in range(1, 400):
+            spin(1, only=("N1", "N2"))
+            if t_coord is None and int(nodes["N1"]._coord_view[row]) == 1:
+                t_coord = t
+            if done2:
+                t_commit = t
+                break
+        key = "fast" if fast else "full_prepare"
+        out[key] = {
+            "ticks_to_coordinator": t_coord,
+            "ticks_to_first_commit": t_commit,
+            "sim_ms_to_coordinator": (None if t_coord is None
+                                      else round(t_coord * ms_per_round, 1)),
+            "sim_ms_to_first_commit": (None if t_commit is None
+                                       else round(t_commit * ms_per_round, 1)),
+        }
+    f, c = out["fast"], out["full_prepare"]
+    if f["ticks_to_coordinator"] and c["ticks_to_coordinator"]:
+        out["coordinator_speedup"] = round(
+            c["ticks_to_coordinator"] / f["ticks_to_coordinator"], 2)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--topo", default="us3", choices=sorted(GEO_TOPOLOGIES))
+    ap.add_argument("--ticks-per-phase", type=int, default=160)
+    ap.add_argument("--every", type=int, default=4)
+    ap.add_argument("--ms-per-round", type=float, default=10.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    t0 = time.monotonic()
+    result = {
+        "generated_unix": int(time.time()),
+        "environment": {"cpu_count": os.cpu_count(),
+                        "python": sys.version.split()[0],
+                        "note": ("latencies are SIMULATED WAN ms "
+                                 "(SimNet geo profiles), not wall clock")},
+        "soak_full_prepare": soak(args.topo, False, args.seed,
+                                  args.ticks_per_phase, args.every,
+                                  args.ms_per_round),
+        "soak_fast_reelection": soak(args.topo, True, args.seed,
+                                     args.ticks_per_phase, args.every,
+                                     args.ms_per_round),
+        "reelection_ab": failover_ab(args.topo, args.seed,
+                                     args.ms_per_round),
+    }
+    result["wall_clock_s"] = round(time.monotonic() - t0, 1)
+    for k in ("soak_full_prepare", "soak_fast_reelection"):
+        assert result[k]["safety"]["violations"] == 0
+        assert result[k]["dbs_converged"]
+
+    out = args.out
+    if out != "-":
+        out = out or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "results_geo_soak_pr6.json")
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+        result["written"] = out
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -217,6 +217,78 @@ def bench_modeb_scale() -> list:
     return _script(["benchmarks/modeb_scale.py", "--platform", "cpu"])
 
 
+def bench_geo_soak() -> dict:
+    """Region-loss SLO (benchmarks/geo_soak.py): refreshes the committed
+    results_geo_soak_pr6.json and surfaces the headline here — simulated ms
+    to a new coordinator after losing the coordinator's region, fast
+    (consecutive-ballot) vs classical full-prepare re-election."""
+    r = _script(["benchmarks/geo_soak.py"])[-1]
+    for k in ("soak_full_prepare", "soak_fast_reelection"):
+        if r[k]["safety"]["violations"]:
+            raise RuntimeError(f"{k}: S1 safety violations in soak")
+    return {
+        "metric": "geo_region_loss_time_to_new_coordinator_sim_ms",
+        "value": r["soak_fast_reelection"]["time_to_new_coordinator_ms"],
+        "unit": "sim_ms (fast re-election; not wall clock)",
+        "full_prepare_sim_ms":
+            r["soak_full_prepare"]["time_to_new_coordinator_ms"],
+        "reelection_ab": r["reelection_ab"],
+        "during_region_loss_p50_ms": {
+            "full_prepare": r["soak_full_prepare"]["slo"]["during"]["p50_ms"],
+            "fast": r["soak_fast_reelection"]["slo"]["during"]["p50_ms"],
+        },
+        "artifact": r.get("written"),
+    }
+
+
+def bench_chaos_replay() -> dict:
+    """The chaos harness replay contract as a checked artifact: the same
+    (seed, schedule) executed twice must produce a bit-identical applied-
+    event log AND identical replicated state — what makes a recorded chaos
+    run a sharable repro."""
+    from gigapaxos_tpu.config import GigapaxosTpuConfig
+    from gigapaxos_tpu.models.replicable import KVApp
+    from gigapaxos_tpu.modeb import ModeBNode
+    from gigapaxos_tpu.testing.chaos import (ChaosEvent, SimChaosRunner,
+                                             coordinator_crash)
+    from gigapaxos_tpu.testing.simnet import SimNet
+
+    ids = ["N0", "N1", "N2"]
+    sched = coordinator_crash("N0", crash_at=25, recover_at=120,
+                              detect_after=4)
+    sched.events = sched.events + [
+        ChaosEvent(5 + 20 * i, "propose",
+                   {"node": ids[i % 3], "group": "svc",
+                    "payload": f"PUT k{i} v{i}"}) for i in range(6)
+    ]
+    outs = []
+    for _ in range(2):
+        net = SimNet(seed=11)
+        cfg = GigapaxosTpuConfig()
+        cfg.paxos.max_groups = 8
+        apps = {n: KVApp() for n in ids}
+        nodes = {n: ModeBNode(cfg, ids, n, apps[n], net.messenger(n),
+                              anti_entropy_every=8) for n in ids}
+        for nd in nodes.values():
+            nd.create_group("svc", [0, 1, 2])
+        runner = SimChaosRunner(net, nodes, sched)
+        log = runner.run(220)
+        runner.ledger.assert_safe()
+        outs.append((log.to_json(),
+                     json.dumps([apps[n].db for n in ids], sort_keys=True)))
+    identical = outs[0] == outs[1]
+    if not identical:
+        raise RuntimeError("chaos replay diverged: log/state not identical")
+    return {
+        "metric": "chaos_replay_bit_identical",
+        "value": 1,
+        "unit": "bool",
+        "schedule": sched.name,
+        "events": len(sched.events),
+        "log_bytes": len(outs[0][0]),
+    }
+
+
 def _best_of(fn, n: int) -> dict:
     """Run a bench ``n`` times and keep the best run.  The box these
     artifacts are produced on is a single shared core — interference can
@@ -273,6 +345,9 @@ def main() -> None:
     run("stack_wal", lambda: bench_stack(["--groups", G, "--wal"]))
     run("stack_device", lambda: bench_stack(["--groups", G, "--device"]))
     run("modeb_scale", bench_modeb_scale)
+    # chaos/WAN scenario plane (PR 6): region-loss SLO + replay contract
+    run("geo_soak", bench_geo_soak)
+    run("chaos_replay", bench_chaos_replay)
 
     out = args.out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),

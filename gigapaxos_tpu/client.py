@@ -160,11 +160,23 @@ class ReconfigurableAppClient:
 
         ``on_reply(resp, retried)`` may map the response before it is
         returned; ``retried`` is True when an earlier attempt timed out
-        (it may have committed server-side)."""
+        (it may have committed server-side).
+
+        Retries back off exponentially with full random jitter (the AWS
+        "full jitter" scheme): a failed-over RC otherwise gets every
+        client's retry k at exactly t0 + k*per — a synchronized retry storm
+        arriving the instant it is least able to absorb it.  The jittered
+        sleep spreads the herd over the backoff window; the per-try await
+        still bounds total latency."""
         last: Optional[Exception] = None
         per = max(timeout / tries, 0.5)
         retried = False
-        for _ in range(tries):
+        backoff = 0.1
+        for attempt in range(tries):
+            if attempt > 0:
+                # full jitter: uniform in (0, backoff]; doubles per retry
+                time.sleep(random.uniform(0.0, backoff))
+                backoff = min(backoff * 2, 2.0)
             rc = next(self._rc_rr)
             p = dict(packet)
             p["rid"] = self._rid()

@@ -143,6 +143,17 @@ class PaxosTuning:
     # group-data-parallelism, zero collectives in the hot phases (the
     # v5e-4 deployment shape).
     mesh_replica_shards: int = 1
+    # Consecutive-ballot fast re-election (arxiv 2006.01885): a candidate
+    # whose promised ballot is the group-max among member rows takes over
+    # at the predecessor's successor ballot WITHOUT a prepare round —
+    # straight to coord_active, seeding proposals from its own mirrors.
+    # Safety is preserved by marking such ballots "fast" (coord_fast):
+    # acceptors refuse a fast push that would overwrite a *different*
+    # accepted value, and the fast coordinator adopts any higher-ballot
+    # accepted value it can see, bumping its (still consecutive) ballot.
+    # Mode B only (Mode A elections already complete same-tick); default
+    # off — the legacy election path is bit-identical when disabled.
+    fast_reelection: bool = False
     # Tick coalescing: minimum spacing between driver ticks while busy.
     # Each tick has a fixed host cost (admission, placement, compaction
     # unpack); spacing ticks lets requests accumulate so that cost
@@ -227,6 +238,15 @@ class FailureDetectionConfig:
     ping_interval_s: float = 0.1  # max 1 ping / 100ms, FailureDetection.java:65-66
     timeout_s: float = 3.0
     coordinator_failover_grace_ticks: int = 2
+    # Adaptive timeout (Jacobson/TCP-RTO style): per-node EWMA of ping
+    # inter-arrival gaps; effective timeout = max(timeout_s,
+    # adaptive_beta * (mean + 4 * meandev)).  Jittery WAN links then get a
+    # longer fuse than the static floor, so transient delay spikes don't
+    # flap the alive mask and trigger dueling-coordinator churn; quiet
+    # links keep the configured floor.
+    adaptive: bool = False
+    adaptive_beta: float = 1.5
+    adaptive_gain: float = 0.125  # EWMA gain for mean and mean deviation
 
 
 @dataclass

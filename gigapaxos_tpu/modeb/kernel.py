@@ -55,28 +55,33 @@ from ..ops.tick import TickInbox, paxos_tick_impl
 
 #: own-row state fields shipped in replica frames ([R, G] / [R, W, G])
 FRAME_FIELDS_2D = ("exec_slot", "bal_num", "bal_coord", "status",
-                   "coord_active", "coord_preparing", "coord_bnum",
-                   "next_slot")
+                   "coord_active", "coord_preparing", "coord_fast",
+                   "coord_bnum", "next_slot")
 FRAME_FIELDS_3D = ("acc_bnum", "acc_bcoord", "acc_req", "acc_slot",
                    "acc_stop", "dec_req", "dec_slot", "dec_valid",
                    "dec_stop", "prop_req", "prop_slot", "prop_valid",
                    "prop_stop")
 
 
-def node_tick_impl(state, inbox: TickInbox, r: int):
+def node_tick_impl(state, inbox: TickInbox, r: int, fast: bool = False):
     """One Mode-B node step: fused dataflow, own-row commit, change mask.
 
     Returns (state', outbox, changed[G]) where ``changed`` marks groups
     whose own-row frame fields differ from before (the delta-frame mask —
     the batching analog of PaxosPacketBatcher coalescing per-peer traffic,
     gigapaxos/PaxosPacketBatcher.java:28-35).
+
+    ``fast`` enables consecutive-ballot fast re-election (see
+    ``paxos_tick_impl``); the ``coord_fast`` bit it maintains travels in
+    the frame flags word, so peers' acceptors apply the conflict-refusal
+    rule to this node's fast pushes.
     """
     # a node program is single-device by construction (each Mode-B process
     # owns one chip) — never GSPMD-partitioned — so the Pallas gathers are
     # safe here even when the host exposes multiple devices, where the
     # backend-wide heuristic in use_pallas_gather() would refuse them
     with shard_local_trace():
-        new, out = paxos_tick_impl(state, inbox, own_row=r)
+        new, out = paxos_tick_impl(state, inbox, own_row=r, fast_elect=fast)
     R = state.exec_slot.shape[0]
     row2 = (jnp.arange(R) == r)[:, None]        # [R, 1]
     row3 = row2[:, None, :]                      # [R, 1, 1]
@@ -97,21 +102,21 @@ def node_tick_impl(state, inbox: TickInbox, r: int):
 
 
 @functools.lru_cache(maxsize=None)
-def node_tick(r: int):
-    """Jitted per-node step (r static; state donated)."""
-    return jax.jit(functools.partial(node_tick_impl, r=r),
+def node_tick(r: int, fast: bool = False):
+    """Jitted per-node step (r, fast static; state donated)."""
+    return jax.jit(functools.partial(node_tick_impl, r=r, fast=fast),
                    donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=None)
-def node_tick_packed(r: int):
+def node_tick_packed(r: int, fast: bool = False):
     """Jitted per-node step returning (state', flat_i32) where the flat
     buffer is pack_outbox(outbox) ++ changed — ONE device->host transfer
     per tick instead of one per consumed field (see ops/tick.HostOutbox)."""
     from ..ops.tick import pack_outbox_impl
 
     def impl(state, inbox):
-        new, out, changed = node_tick_impl(state, inbox, r)
+        new, out, changed = node_tick_impl(state, inbox, r, fast)
         flat = jnp.concatenate(
             [pack_outbox_impl(out), changed.astype(jnp.int32)]
         )
@@ -132,7 +137,7 @@ def unpack_node_tick(flat, R: int, P: int, W: int, G: int):
 
 
 @functools.lru_cache(maxsize=None)
-def node_tick_device(r: int, K: int):
+def node_tick_device(r: int, K: int, fast: bool = False):
     """Jitted per-node step with the device KV app fused behind it (the
     Mode-B twin of models/device_kv.fused_compact): descriptor upload +
     consensus tick + own-row on-device execution in ONE program.
@@ -155,7 +160,7 @@ def node_tick_device(r: int, K: int):
     def impl(state, kv, inbox, reg_rids, reg_ops, reg_keys, reg_vals, hold):
         kv = register_requests(kv, reg_rids, reg_ops, reg_keys, reg_vals,
                                mix=True)
-        new, out, changed = node_tick_impl(state, inbox, r)
+        new, out, changed = node_tick_impl(state, inbox, r, fast)
         er = out.exec_req[r:r + 1]      # [1, W, G]
         ec = out.exec_count[r:r + 1]
         kv2, resp, miss = kv_apply(kv, er, ec, mix=True)
@@ -199,8 +204,8 @@ def frame_extract(r: int, K: int):
     The round-2 path sliced ~21 fields individually (one dispatch+transfer
     each) per frame per tick; K is pow2-padded so the jit cache stays
     bounded."""
-    from .wire import FLAG_COORD_ACTIVE, FLAG_COORD_PREPARING, RING_BITS, \
-        RINGS, SCALARS
+    from .wire import FLAG_COORD_ACTIVE, FLAG_COORD_FAST, \
+        FLAG_COORD_PREPARING, RING_BITS, RINGS, SCALARS
 
     def impl(state, rows):
         parts = []
@@ -209,7 +214,9 @@ def frame_extract(r: int, K: int):
         flags = (state.coord_active[r, rows].astype(jnp.int32)
                  * FLAG_COORD_ACTIVE
                  + state.coord_preparing[r, rows].astype(jnp.int32)
-                 * FLAG_COORD_PREPARING)
+                 * FLAG_COORD_PREPARING
+                 + state.coord_fast[r, rows].astype(jnp.int32)
+                 * FLAG_COORD_FAST)
         parts.append(flags)
         for f in RINGS + RING_BITS:
             parts.append(getattr(state, f)[r][:, rows].T)            # [K, W]
@@ -260,8 +267,8 @@ def mirror_apply_impl(state, sr, rows, scalars, flags, rings, bits):
     rings: i32 [NR, K, W] in wire.RINGS order; bits: bool [NB, K, W] in
     wire.RING_BITS order.
     """
-    from .wire import (FLAG_COORD_ACTIVE, FLAG_COORD_PREPARING, RING_BITS,
-                       RINGS, SCALARS)
+    from .wire import (FLAG_COORD_ACTIVE, FLAG_COORD_FAST,
+                       FLAG_COORD_PREPARING, RING_BITS, RINGS, SCALARS)
 
     upd = {}
     for i, f in enumerate(SCALARS):
@@ -271,6 +278,9 @@ def mirror_apply_impl(state, sr, rows, scalars, flags, rings, bits):
     )
     upd["coord_preparing"] = state.coord_preparing.at[sr, rows].set(
         (flags & FLAG_COORD_PREPARING) > 0, mode="drop"
+    )
+    upd["coord_fast"] = state.coord_fast.at[sr, rows].set(
+        (flags & FLAG_COORD_FAST) > 0, mode="drop"
     )
     for i, f in enumerate(RINGS):
         upd[f] = getattr(state, f).at[sr, :, rows].set(rings[i], mode="drop")

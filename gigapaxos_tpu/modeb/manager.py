@@ -224,9 +224,11 @@ class ModeBNode(ModeBCommon):
                 collections.OrderedDict()
             )
             self._tick_device = node_tick_device(
-                self.r, self._kv_reg_budget
+                self.r, self._kv_reg_budget, cfg.paxos.fast_reelection
             )
-        self._tick_packed = node_tick_packed(self.r)
+        self._tick_packed = node_tick_packed(
+            self.r, cfg.paxos.fast_reelection
+        )
         # preallocated inbox staging (entries cleared lazily next build)
         self._in_req = np.zeros((self.R, self.P, self.G), np.int32)
         self._in_stp = np.zeros((self.R, self.P, self.G), bool)

@@ -69,6 +69,10 @@ VERSION = 2  # v2: columnar payload table; v1 (interleaved) still decodes
 
 FLAG_COORD_ACTIVE = 1
 FLAG_COORD_PREPARING = 2
+#: the reign was won by consecutive-ballot fast election (no prepare round);
+#: acceptors use it for the conflict-refusal rule.  Rides the existing
+#: flags i32, so the wire layout is unchanged.
+FLAG_COORD_FAST = 4
 
 #: [R, G] scalar columns shipped per group (+ flags packed separately)
 SCALARS = ("exec_slot", "bal_num", "bal_coord", "status", "coord_bnum",

@@ -183,20 +183,23 @@ def kv_apply(kv: DeviceKVState, exec_req: jnp.ndarray,
     return kv2, responses, miss
 
 
-def fused_step(state, kv: DeviceKVState, inbox: TickInbox, own_row: int = -1):
+def fused_step(state, kv: DeviceKVState, inbox: TickInbox, own_row: int = -1,
+               fast_elect: bool = False):
     """One consensus tick + device app execution in a single program."""
-    new_state, out = paxos_tick_impl(state, inbox, own_row)
+    new_state, out = paxos_tick_impl(state, inbox, own_row,
+                                     fast_elect=fast_elect)
     kv2, responses, miss = kv_apply(kv, out.exec_req, out.exec_count)
     return new_state, kv2, out, responses, miss
 
 
 fused_step_jit = jax.jit(fused_step, donate_argnums=(0, 1),
-                         static_argnums=(3,))
+                         static_argnums=(3, 4))
 
 
 def _fused_compact_impl(state, kv: DeviceKVState, inbox: TickInbox,
                         reg_rids, reg_ops, reg_keys, reg_vals,
-                        own_row: int, exec_budget: int, lag_budget: int):
+                        own_row: int, exec_budget: int, lag_budget: int,
+                        fast_elect: bool = False):
     """Descriptor upload + consensus tick + KV apply + outbox compaction in
     ONE device program: the deployment-path twin of :func:`fused_step`.
 
@@ -210,7 +213,8 @@ def _fused_compact_impl(state, kv: DeviceKVState, inbox: TickInbox,
     from ..ops.tick import _compact_outbox_impl, paxos_tick_impl
 
     kv = register_requests(kv, reg_rids, reg_ops, reg_keys, reg_vals)
-    new_state, out = paxos_tick_impl(state, inbox, own_row, exec_budget)
+    new_state, out = paxos_tick_impl(state, inbox, own_row, exec_budget,
+                                     fast_elect=fast_elect)
     kv2, responses, miss = kv_apply(kv, out.exec_req, out.exec_count)
     packed = _compact_outbox_impl(out, exec_budget, lag_budget)
     # responses ride a second scatter with the same ranks as the exec stream
@@ -239,7 +243,7 @@ def _fused_compact_impl(state, kv: DeviceKVState, inbox: TickInbox,
 
 
 fused_compact = jax.jit(_fused_compact_impl, donate_argnums=(0, 1),
-                        static_argnums=(7, 8, 9))
+                        static_argnums=(7, 8, 9, 10))
 
 
 #: descriptor wire format for device-app request payloads: op, key, value

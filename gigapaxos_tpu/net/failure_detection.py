@@ -40,12 +40,26 @@ class FailureDetection:
         ping_interval_s: float = 0.1,
         timeout_s: float = 3.0,
         on_change: Optional[Callable[[str, bool], None]] = None,
+        adaptive: bool = False,
+        adaptive_beta: float = 1.5,
+        adaptive_gain: float = 0.125,
     ):
         self.m = messenger
         self.me = messenger.node_id
         self.ping_interval_s = max(ping_interval_s, 0.01)
         self.timeout_s = max(timeout_s, 2 * self.ping_interval_s)
         self.on_change = on_change
+        # Adaptive timeout (Jacobson RTO-style): per-node EWMA of
+        # inter-arrival gaps and their mean deviation; effective timeout =
+        # max(timeout_s, beta * (mean + 4 * dev)).  Floored at the
+        # configured value — adaptation only ever LENGTHENS the fuse on
+        # jittery links (so WAN delay spikes don't flap the alive mask into
+        # dueling-coordinator churn), never shortens it below config.
+        self.adaptive = adaptive
+        self.adaptive_beta = adaptive_beta
+        self.adaptive_gain = adaptive_gain
+        self._gap_mean: Dict[str, float] = {}
+        self._gap_dev: Dict[str, float] = {}
         self._lock = threading.Lock()
         self._monitored: List[str] = []
         self._last_heard: Dict[str, float] = {}
@@ -84,6 +98,8 @@ class FailureDetection:
             # forget history so a later re-monitor gets a fresh grace window
             self._last_heard.pop(node, None)
             self._was_up.pop(node, None)
+            self._gap_mean.pop(node, None)
+            self._gap_dev.pop(node, None)
 
     def heard_from(self, node: str) -> None:
         """Feed from any inbound packet (wire into the demux default path).
@@ -93,8 +109,37 @@ class FailureDetection:
         state here."""
         now = time.monotonic()
         with self._lock:
-            if node in self._last_heard:
-                self._last_heard[node] = now
+            last = self._last_heard.get(node)
+            if last is None:
+                return
+            self._last_heard[node] = now
+            if self.adaptive:
+                gap = now - last
+                g = self.adaptive_gain
+                mean = self._gap_mean.get(node)
+                if mean is None:
+                    self._gap_mean[node] = gap
+                    self._gap_dev[node] = gap / 2.0
+                else:
+                    err = gap - mean
+                    self._gap_mean[node] = mean + g * err
+                    self._gap_dev[node] = (
+                        self._gap_dev[node]
+                        + g * (abs(err) - self._gap_dev[node])
+                    )
+
+    def current_timeout(self, node: str) -> float:
+        """Effective timeout for ``node``: the configured floor, lengthened
+        by the adaptive inter-arrival estimate when enabled."""
+        if not self.adaptive:
+            return self.timeout_s
+        with self._lock:
+            mean = self._gap_mean.get(node)
+            dev = self._gap_dev.get(node, 0.0)
+        if mean is None:
+            return self.timeout_s
+        return max(self.timeout_s,
+                   self.adaptive_beta * (mean + 4.0 * dev))
 
     def is_node_up(self, node: str) -> bool:
         """``isNodeUp`` (FailureDetection.java:252-258); self is always up."""
@@ -102,7 +147,8 @@ class FailureDetection:
             return True
         with self._lock:
             last = self._last_heard.get(node)
-        return last is not None and (time.monotonic() - last) < self.timeout_s
+        return (last is not None
+                and (time.monotonic() - last) < self.current_timeout(node))
 
     def alive_mask(self, nodes: List[str]) -> np.ndarray:
         """Dense liveness view for the tick inbox: nodes[i] -> alive[i]."""

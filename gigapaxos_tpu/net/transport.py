@@ -175,6 +175,10 @@ class _Peer:
         self.gen = 0
         self.glock = threading.Lock()
         self._carry = None  # writer-owned: see _drain_batch
+        #: interrupts the writer's reconnect-backoff sleep: set by close()
+        #: and Transport.reset_peer so shutdown / peer reset aren't delayed
+        #: up to 2 s by a dead link waiting out its backoff
+        self.wake = threading.Event()
         self.thread = threading.Thread(
             target=self._run, name=f"tx-{transport.node_id}->{dest}", daemon=True
         )
@@ -250,7 +254,10 @@ class _Peer:
                         if attempts > self.t.max_connect_attempts:
                             self.t._count("dropped", len(batch))
                             break
-                        time.sleep(min(backoff * (2 ** attempts), 2.0))
+                        # interruptible: close()/reset_peer set wake so a
+                        # dead link's backoff never stalls shutdown/reset
+                        self.wake.wait(min(backoff * (2 ** attempts), 2.0))
+                        self.wake.clear()
                         continue
                     backoff = 0.05
                 if self.gen != gen:
@@ -272,6 +279,7 @@ class _Peer:
                     self.sock = None  # reconnect and retry this batch
 
     def close(self) -> None:
+        self.wake.set()  # pop the writer out of any reconnect backoff
         s = self.sock  # snapshot: the writer nulls this field concurrently
         if s is not None:
             try:

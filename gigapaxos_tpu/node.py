@@ -242,6 +242,9 @@ class InProcessCluster:
                     self.reconfigurators[r].m, monitored=rc_ids,
                     ping_interval_s=cfg.fd.ping_interval_s,
                     timeout_s=cfg.fd.timeout_s,
+                    adaptive=cfg.fd.adaptive,
+                    adaptive_beta=cfg.fd.adaptive_beta,
+                    adaptive_gain=cfg.fd.adaptive_gain,
                     on_change=self._fd_change,
                 )
 

@@ -33,6 +33,19 @@ def bal_max(an, ac, bn, bc):
     return jnp.where(take_a, an, bn), jnp.where(take_a, ac, bc)
 
 
+def bal_consecutive(an, bn):
+    """True where ballot number ``an`` is the immediate successor of ``bn``.
+
+    The consecutive-ballots optimization (arxiv 2006.01885) keys on ballot
+    *numbers* only: a coordinator taking over at bn+1 whose own promised
+    ballot already equals the group maximum has seen every accept the
+    predecessor could have pushed, so the prepare round's snapshot would be
+    redundant.  Coordinator ids break ties elsewhere (bal_gt/bal_ge); the
+    consecutive test is purely numeric.
+    """
+    return an == bn + 1
+
+
 def slot_after(a, b):
     """True where slot a is logically after slot b (wraparound-aware)."""
     return (a - b).astype(jnp.int32) > 0

@@ -120,9 +120,58 @@ def _lexmax(n, c, axis):
 
 
 def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
-                    exec_budget: int = 0, group_axis: str | None = None):
+                    exec_budget: int = 0, group_axis: str | None = None,
+                    fast_elect: bool = False):
     """Un-jitted tick body (jit/shard it yourself; `paxos_tick` below is the
     ready-made single-program jit with state donation).
+
+    fast_elect: static flag enabling consecutive-ballot fast re-election
+    (arxiv 2006.01885).  When False (default) the compiled graph is the
+    legacy election path, bit for bit.  When True, three coupled rules
+    activate:
+
+    * **fast takeover** (phase 0): the candidate skips the prepare round
+      and goes straight to ``coord_active`` when its own promised ballot
+      already equals the group max over member rows — the new ballot is
+      then the predecessor's immediate successor, so every accept the
+      predecessor could have pushed is visible in the candidate's mirrors
+      and the prepare snapshot would be redundant.  Such a reign is marked
+      ``coord_fast`` (the bit rides the frame flags word).
+    * **conflict refusal** (phase 2b): because a fast ballot never
+      collected promises, an acceptor refuses a fast push that would
+      overwrite a *different* accepted value (same value / empty slot
+      accepts normally, and the refusal still raises the promise).  Any
+      chosen value therefore stays held by a blocking set — a conflicting
+      fast value can never reach a majority (quorum intersection), which
+      is the safety argument for skipping prepare.
+    * **adoption + consecutive bump** (between intake and 2b): a fast
+      coordinator that can see a higher-ballot accepted value differing
+      from its own proposal adopts that value and bumps its ballot by one
+      (proposals carry no per-slot ballot, so re-pushing a different value
+      under the SAME ballot would corrupt the per-ballot vote tally).  The
+      bump keeps the ballot consecutive, so the reign stays fast.
+
+    Liveness escape: a refused fast push can stall behind a refuser plus a
+    dead node (the classical path would overwrite after fresh promises).
+    When the coordinator can *prove* a refusal from its mirrors — a member
+    promised at/above the pushed ballot while a conflicting lower-ballot
+    value stays accepted — it demotes itself to an ordinary full prepare
+    at the next ballot, which is always safe.
+
+    Known residual window (why the flag defaults to False): with majority
+    quorums, a recovery prepare cannot always distinguish "old prepared
+    value chosen, fast value partially accepted" from the mirror-image
+    world — the promise sets can be identical (the Fast Paxos quorum
+    lower bound: safe uncoordinated rounds need ~3n/4 quorums or a
+    Raft-style up-to-dateness vote).  Concretely, a value the dead
+    coordinator pushed in its final frame RTT can be invisible to the
+    taker's mirrors, and if that value was chosen AND its decision also
+    never surfaced, a later classical recovery ranks the fast pvalue
+    above it by ballot.  Exploiting the window needs a chosen-but-
+    unlearned value younger than one frame RTT at takeover plus a second
+    coordinator death before the demote resolves; the chaos soaks assert
+    the per-slot ledger across every scheduled run, but the flag stays
+    opt-in until the fast-quorum variant closes the window.
 
     group_axis: name of a mesh axis the group dimension G is sharded over
     when this body is traced inside a shard_map (``parallel/shard_tick``).
@@ -210,14 +259,28 @@ def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
     have_auth = (state.coord_active | state.coord_preparing) & bal_ge(
         state.coord_bnum, r_idx, state.bal_num, state.bal_coord
     )
-    start_prep = im_cand & coord_dead & ~have_auth & own2
+    start_any = im_cand & coord_dead & ~have_auth & own2
+    if fast_elect:
+        # consecutive-ballot fast takeover: my promise is already the group
+        # max among member rows (mirror facts included — they only ever
+        # under-report), so max(bal_num, coord_bnum)+1 below is the
+        # predecessor's immediate successor and prepare is skippable.
+        gmax_bn = jnp.max(jnp.where(member, state.bal_num, NEG_INF), axis=0)
+        consec = (state.bal_num == gmax_bn[None, :]) & (
+            state.bal_num >= state.coord_bnum
+        )
+        fast_start = start_any & consec
+        start_prep = start_any & ~consec
+    else:
+        start_prep = start_any
     coord_bnum = jnp.where(
-        start_prep,
+        start_any,
         jnp.maximum(state.bal_num, state.coord_bnum) + 1,
         state.coord_bnum,
     )
     coord_preparing = state.coord_preparing | start_prep
     coord_active = state.coord_active
+    coord_fast = state.coord_fast
 
     # ---------------- phase 1: prepare / promise / carryover ----------------
     prep_mask = coord_preparing & acc_ok  # [R, G] candidates broadcasting
@@ -231,6 +294,13 @@ def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
     )
     bal_num = jnp.where(upgrade, best_pn[None, :], state.bal_num)
     bal_coord = jnp.where(upgrade, best_pc[None, :], state.bal_coord)
+    if fast_elect:
+        # a fast winner promises its own new ballot at once (the analog of
+        # the promise a full winner collects from itself via prep_mask)
+        bal_num = jnp.where(fast_start, coord_bnum, bal_num)
+        bal_coord = jnp.where(
+            fast_start, jnp.broadcast_to(r_idx, (R, G)), bal_coord
+        )
 
     # promise match[r1, r2, g]: acceptor r2's promised ballot == candidate r1's
     match = (
@@ -253,7 +323,15 @@ def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
 
     # carryover: among the winner's promisers, max-ballot accepted pvalue/slot
     promiser = jnp.einsum("rg,rsg->sg", won, match).astype(jnp.bool_)  # [R, G]
-    eff = promiser[:, None, :] & acc_here
+    if fast_elect:
+        # a fast winner has no promisers; its carryover source is every
+        # member row of its own mirrors (monotone facts — a stale mirror
+        # under-reports, which only makes the seeded prefix shorter)
+        fast_any = jnp.any(fast_start, axis=0)  # [G]
+        sel_rows = jnp.where(fast_any[None, :], member, promiser)
+        eff = sel_rows[:, None, :] & acc_here
+    else:
+        eff = promiser[:, None, :] & acc_here
     c_n, c_c = _lexmax(jnp.where(eff, a_bnum, NEG_INF), a_bcoord, axis=0)  # [W, G]
     c_exists = jnp.any(eff, axis=0)
     sel = eff & (a_bnum == c_n[None]) & (a_bcoord == c_c[None])
@@ -261,7 +339,22 @@ def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
     c_stop = jnp.any(sel & a_stop, axis=0)
     # noop-fill gaps below the highest carried slot so later slots can commit
     hi = jnp.max(jnp.where(c_exists, jw, -1), axis=0)  # [G], -1 if none
-    c_valid = jw <= hi[None, :]  # [W, G] window order
+    if fast_elect:
+        # a fast winner also covers the predecessor's visible assignment
+        # frontier (max member next_slot): slots the predecessor assigned
+        # whose accepts this candidate hasn't seen get noop proposals
+        # instead of gaps (the refusal rule keeps any real value safe; the
+        # adoption rule converges them).  Capped at base+W (ring capacity).
+        next_mem = jnp.max(jnp.where(member, state.next_slot, NEG_INF), axis=0)
+        fast_next = jnp.minimum(
+            jnp.maximum(base + hi + 1, next_mem), base + W
+        )  # [G]
+        hi_eff = jnp.where(fast_any, fast_next - base - 1, hi)
+        ns_win = jnp.where(fast_any, fast_next, base + hi + 1)
+        c_valid = jw <= hi_eff[None, :]  # [W, G] window order
+    else:
+        ns_win = base + hi + 1
+        c_valid = jw <= hi[None, :]  # [W, G] window order
     # window-order -> ring-order: ring plane i holds window offset (i-base)%W
     j_of_i = jnp.bitwise_and(jw - base[None, :], Wm)  # [W, G]
 
@@ -274,15 +367,18 @@ def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
         to_ring(c_valid),
         to_ring(s_j),
     )
-    won3 = won[:, None, :]
+    won_any = (won | fast_start) if fast_elect else won
+    won3 = won_any[:, None, :]
     prop_req = jnp.where(won3, co_req[None], state.prop_req)
     prop_slot = jnp.where(won3, co_slot[None], state.prop_slot)
     prop_valid = jnp.where(won3, co_valid[None], state.prop_valid)
     prop_stop = jnp.where(won3, co_stop[None], state.prop_stop)
-    next_slot = jnp.where(won, base[None, :] + hi[None, :] + 1, state.next_slot)
+    next_slot = jnp.where(won_any, ns_win[None, :], state.next_slot)
 
-    coord_active = coord_active | won
+    coord_active = coord_active | won_any
     coord_preparing = coord_preparing & ~won
+    if fast_elect:
+        coord_fast = (coord_fast | fast_start) & ~won
     # retirement: somebody holds a promise above my ballot (preemption,
     # handleAcceptReplyHigherBallot analog, PaxosCoordinatorState.java:661)
     pm_n, pm_c = _lexmax(
@@ -291,6 +387,8 @@ def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
     retire = bal_gt(pm_n[None, :], pm_c[None, :], coord_bnum, r_idx)
     coord_active = coord_active & ~retire
     coord_preparing = coord_preparing & ~retire
+    if fast_elect:
+        coord_fast = coord_fast & ~retire
     prop_valid = prop_valid & ~retire[:, None, :]
 
     # ---------------- phase 2a: intake + slot assignment ----------------
@@ -344,6 +442,42 @@ def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
 
     intake_taken = taken_flat.reshape(R, P, G)
 
+    if fast_elect:
+        # ---- fast-coordinator adoption + consecutive bump ----
+        # A fast reign skipped the prepare snapshot, so a proposal seeded
+        # from stale mirrors may conflict with a higher-ballot accepted
+        # value that IS visible now.  Adopt the max-ballot accepted value
+        # strictly below my own ballot wherever it differs from my
+        # proposal, and bump my ballot by one per affected group: the
+        # re-push must be a fresh ballot (vote tallies key on ballot —
+        # two values under one ballot would corrupt them), and +1 keeps
+        # the reign consecutive, hence still fast.
+        vis = member[:, None, :] & acc_here  # [R, W, G] pre-tick facts
+        m_n, m_c = _lexmax(jnp.where(vis, a_bnum, NEG_INF), a_bcoord, axis=0)
+        m_sel = vis & (a_bnum == m_n[None]) & (a_bcoord == m_c[None])
+        m_req = jnp.max(jnp.where(m_sel, a_req, 0), axis=0)  # [W, G]
+        m_stop = jnp.any(m_sel & a_stop, axis=0)
+        ad_req, ad_stop, ad_n, ad_c, ad_slot = (
+            to_ring(m_req), to_ring(m_stop), to_ring(m_n), to_ring(m_c),
+            to_ring(s_j),
+        )
+        fastc = coord_fast & coord_active & own2  # [R, G]
+        below = bal_gt(
+            coord_bnum[:, None, :], r_idx[:, None, :], ad_n[None], ad_c[None]
+        )  # accepted ballot strictly under my own (my ballot's values are mine)
+        adoptp = (
+            fastc[:, None, :]
+            & prop_valid
+            & (ad_n[None] != NEG_INF)
+            & (prop_slot == ad_slot[None])
+            & below
+            & (prop_req != ad_req[None])
+        )
+        prop_req = jnp.where(adoptp, ad_req[None], prop_req)
+        prop_stop = jnp.where(adoptp, ad_stop[None], prop_stop)
+        any_adopt = jnp.any(adoptp, axis=1)  # [R, G]
+        coord_bnum = jnp.where(any_adopt, coord_bnum + 1, coord_bnum)
+
     # ---------------- phase 2b: accept ----------------
     pushing = (coord_active & acc_ok)[:, None, :] & prop_valid  # [R, W, G]
     cand_n = jnp.where(pushing, coord_bnum[:, None, :], NEG_INF)
@@ -364,6 +498,25 @@ def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
         & acc_ok[:, None, :]
         & own2[:, None, :]
     )
+    if fast_elect:
+        # conflict refusal: a push under a fast ballot must not overwrite a
+        # DIFFERENT accepted value — the fast reign never collected
+        # promises, so the classical "prepare saw everything" overwrite
+        # license does not apply.  Same-value pushes still accept (ballot
+        # raise), and the refusal still promises (pr_mask below), so the
+        # coordinator can later prove the refusal from its mirrors.
+        src_fast = jnp.any(psel & coord_fast[:, None, :], axis=0)  # [W, G]
+        conflict = (
+            (state.acc_slot == p_slot[None])
+            & (state.acc_bnum >= 0)
+            & (state.acc_req != p_req[None])
+            & src_fast[None]
+        )  # [R, W, G]
+        refused = acceptable & conflict
+        acceptable = acceptable & ~conflict
+        pr_mask = acceptable | refused
+    else:
+        pr_mask = acceptable
     # ring plane for pvalue at slot p_slot is its own plane position already
     # (coordinators store proposals ring-indexed by slot), so accept in place.
     acc_bnum = jnp.where(acceptable, b_n[None], state.acc_bnum)
@@ -373,13 +526,40 @@ def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
     acc_stop = jnp.where(acceptable, p_stop[None], state.acc_stop)
     # promise-on-accept (acceptAndUpdateBallot raises the promised ballot)
     ab_n, ab_c = _lexmax(
-        jnp.where(acceptable, b_n[None], NEG_INF),
-        jnp.where(acceptable, b_c[None], NEG_INF),
+        jnp.where(pr_mask, b_n[None], NEG_INF),
+        jnp.where(pr_mask, b_c[None], NEG_INF),
         axis=1,
     )  # [R, G]
     raise_p = (ab_n != NEG_INF) & bal_gt(ab_n, ab_c, bal_num, bal_coord)
     bal_num = jnp.where(raise_p, ab_n, bal_num)
     bal_coord = jnp.where(raise_p, ab_c, bal_coord)
+    if fast_elect:
+        # liveness escape: a refuser plus a dead member can block a fast
+        # quorum forever (classical prepare would overwrite).  A refusal is
+        # PROVEN in my mirrors when a member's promise is at/above my
+        # pushed ballot while a conflicting lower-ballot value stays
+        # accepted; demote to an ordinary full prepare at the next ballot
+        # (always safe).  A fresh adoption bump this tick can't false-
+        # positive here: no mirror can already hold a promise at the
+        # just-created ballot.
+        seen_refusal = (
+            conflict
+            & member[:, None, :]
+            & bal_ge(
+                bal_num[:, None, :], bal_coord[:, None, :],
+                b_n[None], b_c[None],
+            )
+        )
+        ref_plane = jnp.any(seen_refusal, axis=0)  # [W, G]
+        mine = b_c[None] == r_idx[:, None, :]  # [R, W, G] my push planes
+        demote = (
+            coord_fast & coord_active & own2
+            & jnp.any(ref_plane[None] & mine, axis=1)
+        )
+        coord_active = coord_active & ~demote
+        coord_fast = coord_fast & ~demote
+        coord_preparing = coord_preparing | demote
+        coord_bnum = jnp.where(demote, coord_bnum + 1, coord_bnum)
 
     # ---------------- phase 2c: tally + quorum ----------------
     A_bnum = gather_planes(acc_bnum, i_j)
@@ -529,6 +709,7 @@ def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
         dec_stop=fr3(dec_stop, state.dec_stop),
         coord_active=fr2(coord_active, state.coord_active),
         coord_preparing=fr2(coord_preparing, state.coord_preparing),
+        coord_fast=fr2(coord_fast, state.coord_fast),
         coord_bnum=fr2(coord_bnum, state.coord_bnum),
         next_slot=fr2(next_slot, state.next_slot),
         prop_req=fr3(prop_req, state.prop_req),
@@ -585,7 +766,7 @@ def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
 
 
 paxos_tick = jax.jit(paxos_tick_impl, donate_argnums=(0,),
-                     static_argnums=(2, 3, 4))
+                     static_argnums=(2, 3, 4, 5))
 
 
 class HostOutbox(NamedTuple):
@@ -649,8 +830,9 @@ def unpack_outbox(flat, R: int, P: int, W: int, G: int) -> HostOutbox:
 
 
 def _paxos_tick_packed_impl(state, inbox: TickInbox, own_row: int = -1,
-                            exec_budget: int = 0):
-    state, out = paxos_tick_impl(state, inbox, own_row, exec_budget)
+                            exec_budget: int = 0, fast_elect: bool = False):
+    state, out = paxos_tick_impl(state, inbox, own_row, exec_budget,
+                                 fast_elect=fast_elect)
     return state, pack_outbox_impl(out)
 
 
@@ -658,7 +840,7 @@ def _paxos_tick_packed_impl(state, inbox: TickInbox, own_row: int = -1,
 #: exec_budget matters even on this full-outbox path: WAL replay of a run
 #: that ticked with a budget must evolve state identically.
 paxos_tick_packed = jax.jit(
-    _paxos_tick_packed_impl, donate_argnums=(0,), static_argnums=(2, 3)
+    _paxos_tick_packed_impl, donate_argnums=(0,), static_argnums=(2, 3, 4)
 )
 
 
@@ -766,15 +948,17 @@ def _compact_outbox_impl(out: TickOutbox, exec_budget: int,
 
 
 def _paxos_tick_compact_impl(state, inbox: TickInbox, own_row: int,
-                             exec_budget: int, lag_budget: int):
-    state, out = paxos_tick_impl(state, inbox, own_row, exec_budget)
+                             exec_budget: int, lag_budget: int,
+                             fast_elect: bool = False):
+    state, out = paxos_tick_impl(state, inbox, own_row, exec_budget,
+                                 fast_elect=fast_elect)
     return state, _compact_outbox_impl(out, exec_budget, lag_budget)
 
 
 #: fused tick + budgeted on-device compaction: one dispatch, one
 #: O(budget) device->host buffer
 paxos_tick_compact = jax.jit(
-    _paxos_tick_compact_impl, donate_argnums=(0,), static_argnums=(2, 3, 4)
+    _paxos_tick_compact_impl, donate_argnums=(0,), static_argnums=(2, 3, 4, 5)
 )
 
 
@@ -909,7 +1093,8 @@ frontier_rows = jax.jit(_frontier_rows_impl)
 
 def _paxos_tick_compact_demand_impl(state, inbox: TickInbox, demand,
                                     own_row: int, exec_budget: int,
-                                    lag_budget: int, decay: float):
+                                    lag_budget: int, decay: float,
+                                    fast_elect: bool = False):
     """Single-device twin of shard_tick's demand-folding compact tick:
     tick + compaction + placement demand EWMA in ONE program.
 
@@ -921,7 +1106,8 @@ def _paxos_tick_compact_demand_impl(state, inbox: TickInbox, demand,
     forces the mesh path's fold into a separate dispatch does not exist in
     a single-device program, and the flat compact buffer stays
     byte-identical."""
-    state, out = paxos_tick_impl(state, inbox, own_row, exec_budget)
+    state, out = paxos_tick_impl(state, inbox, own_row, exec_budget,
+                                 fast_elect=fast_elect)
     per_row = jnp.sum(out.intake_taken.astype(demand.dtype), axis=(0, 1))
     new_demand = decay * demand + per_row
     return state, _compact_outbox_impl(out, exec_budget, lag_budget), new_demand
@@ -929,7 +1115,7 @@ def _paxos_tick_compact_demand_impl(state, inbox: TickInbox, demand,
 
 paxos_tick_compact_demand = jax.jit(
     _paxos_tick_compact_demand_impl, donate_argnums=(0, 2),
-    static_argnums=(3, 4, 5, 6),
+    static_argnums=(3, 4, 5, 6, 7),
 )
 
 

@@ -50,6 +50,7 @@ _STATE_SPECS = dict(
     dec_stop=_RWG,
     coord_active=_RG,
     coord_preparing=_RG,
+    coord_fast=_RG,
     coord_bnum=_RG,
     next_slot=_RG,
     prop_req=_RWG,

@@ -80,6 +80,7 @@ class PaxosState(NamedTuple):
     # ---- coordinator, per replica [R, G] ----
     coord_active: jnp.ndarray  # bool: majority promised my ballot
     coord_preparing: jnp.ndarray  # bool: prepare issued, awaiting promises
+    coord_fast: jnp.ndarray  # bool: active via consecutive-ballot fast election
     coord_bnum: jnp.ndarray  # my ballot number (coordinator id == replica idx)
     next_slot: jnp.ndarray  # next slot I will assign
 
@@ -155,6 +156,7 @@ def init_state(n_replicas: int, n_groups: int, window: int,
         dec_stop=f_rwg(),
         coord_active=f_rg(),
         coord_preparing=f_rg(),
+        coord_fast=f_rg(),
         coord_bnum=jnp.full((R, G), INITIAL_BALLOT_NUM, I32),
         next_slot=z_rg(),
         prop_req=jnp.full((R, W, G), NO_REQUEST, I32),
@@ -245,6 +247,7 @@ def create_groups(state: PaxosState, rows: np.ndarray, members: np.ndarray,
         dec_stop=win(state.dec_stop, False),
         coord_active=col(state.coord_active, False),
         coord_preparing=col(state.coord_preparing, False),
+        coord_fast=col(state.coord_fast, False),
         coord_bnum=col(state.coord_bnum, INITIAL_BALLOT_NUM),
         next_slot=col(state.next_slot, 0),
         prop_req=win(state.prop_req, NO_REQUEST),
@@ -308,6 +311,7 @@ def extract_hri(state: PaxosState, row: int) -> dict:
         "bal_coord": np.array(state.bal_coord[:, r]),
         "status": np.array(state.status[:, r]),
         "coord_active": np.array(state.coord_active[:, r]),
+        "coord_fast": np.array(state.coord_fast[:, r]),
         "coord_bnum": np.array(state.coord_bnum[:, r]),
         "next_slot": np.array(state.next_slot[:, r]),
         "member": np.array(state.member[:, r]),
@@ -340,6 +344,10 @@ def hot_restore(state: PaxosState, row: int, hri: dict) -> PaxosState:
             jnp.asarray(hri["coord_active"], BOOL)
         ),
         coord_preparing=state.coord_preparing.at[:, r].set(False),
+        coord_fast=state.coord_fast.at[:, r].set(
+            jnp.asarray(hri.get("coord_fast", np.zeros_like(hri["coord_active"])),
+                        BOOL)
+        ),
         coord_bnum=state.coord_bnum.at[:, r].set(
             jnp.asarray(hri["coord_bnum"], I32)
         ),

@@ -159,6 +159,9 @@ class ModeBServer:
                     m, monitored=universe_ids,
                     ping_interval_s=cfg.fd.ping_interval_s,
                     timeout_s=cfg.fd.timeout_s,
+                    adaptive=cfg.fd.adaptive,
+                    adaptive_beta=cfg.fd.adaptive_beta,
+                    adaptive_gain=cfg.fd.adaptive_gain,
                 )
                 node.attach_failure_detector(fd)
                 self.fds.append(fd)
@@ -191,6 +194,9 @@ class ModeBServer:
                     m, monitored=rc_ids,
                     ping_interval_s=cfg.fd.ping_interval_s,
                     timeout_s=cfg.fd.timeout_s,
+                    adaptive=cfg.fd.adaptive,
+                    adaptive_beta=cfg.fd.adaptive_beta,
+                    adaptive_gain=cfg.fd.adaptive_gain,
                 )
                 self.fds.append(fd)
             self.reconfigurator = Reconfigurator(

@@ -1,0 +1,413 @@
+"""Declarative fault-schedule harness: scripted chaos over SimNet or
+real OS processes, with a replayable event log and per-slot safety ledger.
+
+The reference hand-codes each failure scenario (``TESTPaxosMain`` crashes
+nodes at fixed request counts; JSONDelayEmulator adds one global delay).
+This module makes scenarios *data*: a :class:`ChaosSchedule` is a JSON-able
+list of ``(at_tick, action, args)`` events — crash/recover, partition/heal,
+slow node, WAL-fsync stall, region cut — executed by an adapter against
+either
+
+* the deterministic in-process stack (:class:`SimChaosRunner` over
+  ``testing.simnet.SimNet`` + ``ModeBNode``), where ``at_tick`` is the
+  exact tick index and the whole run replays bit-identically from
+  ``(seed, schedule)``; or
+* the real multiprocess stack (:class:`ProcChaosRunner` over the
+  ``tests/modeb_worker.py``-style process handles), where ``at_tick``
+  maps to wall-clock offsets and crash/stall become SIGKILL/SIGSTOP.
+
+Every run records the events it applied into a :class:`ChaosLog`
+(JSON-serializable: seed + schedule + applied events + stats), and every
+run carries a :class:`SafetyLedger` asserting the S1 invariant — no two
+replicas ever execute different requests for the same (group, slot).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import signal
+import time
+from typing import Callable, Dict, Iterable, List, Mapping, Optional
+
+#: Actions understood by the SimNet adapter.  The process adapter supports
+#: the subset in :data:`PROC_ACTIONS`; schedules are validated up front so
+#: an unsupported scenario fails loudly, not silently mid-run.
+SIM_ACTIONS = frozenset({
+    "crash", "recover", "partition", "heal", "slow_node", "fsync_stall",
+    "cut_region", "heal_region", "set_delay", "drop_pending",
+    "mark_down", "mark_up", "propose",
+})
+PROC_ACTIONS = frozenset({"crash", "recover", "fsync_stall", "propose"})
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosEvent:
+    """One scripted fault: at tick ``at_tick`` apply ``action(**args)``."""
+
+    at_tick: int
+    action: str
+    args: Mapping[str, object] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"at_tick": self.at_tick, "action": self.action,
+                "args": dict(self.args)}
+
+
+@dataclasses.dataclass
+class ChaosSchedule:
+    """A named, seeded, JSON-able fault scenario."""
+
+    name: str
+    events: List[ChaosEvent]
+    seed: int = 0
+
+    def validate(self, supported: frozenset = SIM_ACTIONS) -> None:
+        for ev in self.events:
+            if ev.action not in supported:
+                raise ValueError(
+                    f"schedule {self.name!r}: action {ev.action!r} not in "
+                    f"{sorted(supported)}")
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "name": self.name, "seed": self.seed,
+            "events": [ev.to_dict() for ev in self.events],
+        }, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "ChaosSchedule":
+        d = json.loads(text)
+        return cls(
+            name=d["name"], seed=int(d.get("seed", 0)),
+            events=[ChaosEvent(int(e["at_tick"]), e["action"],
+                               dict(e.get("args", {})))
+                    for e in d["events"]],
+        )
+
+
+class ChaosLog:
+    """Replayable record of one run: every applied event plus outcome info.
+
+    Two runs of the same ``(seed, schedule)`` over the Sim adapter must
+    produce identical logs — that is the replay contract
+    ``benchmarks/run_artifacts.py`` checks.
+    """
+
+    def __init__(self, schedule: ChaosSchedule):
+        self.schedule = schedule
+        self.records: List[dict] = []
+
+    def record(self, tick: int, action: str, args: Mapping[str, object],
+               **info) -> None:
+        rec = {"tick": tick, "action": action, "args": dict(args)}
+        if info:
+            rec["info"] = info
+        self.records.append(rec)
+
+    def to_dict(self) -> dict:
+        return {"schedule": json.loads(self.schedule.to_json()),
+                "applied": self.records}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+
+class SafetyLedger:
+    """S1 invariant across every replica of a run: for each (group, slot)
+    at most ONE request id is ever executed, cluster-wide.  Noop fills
+    count — a slot decided noop on one replica and a value on another is
+    a real divergence.  (The same rid at two slots is legal: a request
+    re-proposed across a coordinator change can decide twice and is
+    deduped at execution.)"""
+
+    def __init__(self):
+        self.decided: Dict[tuple, int] = {}  # (name, slot) -> rid
+        self.violations: List[dict] = []
+        self.observations = 0
+
+    def observe(self, node_id: str, name: str, slot: int, rid: int) -> None:
+        self.observations += 1
+        key = (name, int(slot))
+        prev = self.decided.setdefault(key, int(rid))
+        if prev != int(rid):
+            self.violations.append({
+                "node": node_id, "group": name, "slot": int(slot),
+                "rid": int(rid), "prev_rid": prev,
+            })
+
+    def attach(self, node_id: str, node) -> None:
+        """Tap ``node``'s execution stream (everything funnels through
+        ``_execute_direct``, including drained digest stalls; checkpoint
+        transfers replace app state wholesale and never claim slots, so
+        they correctly don't appear here)."""
+        orig = node._execute_direct
+
+        def wrapped(row, name, rid, slot, is_stop, response=None,
+                    _orig=orig, _nid=node_id):
+            self.observe(_nid, name, slot, rid)
+            return _orig(row, name, rid, slot, is_stop, response)
+
+        node._execute_direct = wrapped
+
+    def assert_safe(self) -> None:
+        assert not self.violations, (
+            f"S1 violated: two decided values per slot: {self.violations}")
+
+
+# --------------------------------------------------------------------- sim
+class SimChaosRunner:
+    """Execute a schedule against a SimNet-backed ModeBNode cluster.
+
+    ``nodes`` maps node id -> ``ModeBNode`` (ids in member-index order —
+    index i is tick row i, which ``mark_down``/``mark_up`` need).  The
+    runner owns the tick loop: crashed nodes stop ticking and are cut from
+    the wire (their in-memory state survives, i.e. recovery is modeled as
+    a perfect WAL restore); fsync-stalled nodes stop ticking but stay
+    connected, so frames pile into their inbox exactly as a tick thread
+    blocked in ``os.fsync`` would see.
+    """
+
+    def __init__(self, net, nodes: Mapping[str, object],
+                 schedule: ChaosSchedule,
+                 ledger: Optional[SafetyLedger] = None):
+        schedule.validate(SIM_ACTIONS)
+        self.net = net
+        self.nodes = dict(nodes)
+        self.ids = list(nodes)  # insertion order == member index order
+        self.schedule = schedule
+        self.log = ChaosLog(schedule)
+        self.ledger = ledger or SafetyLedger()
+        for nid, nd in self.nodes.items():
+            self.ledger.attach(nid, nd)
+        self._pending = sorted(schedule.events,
+                               key=lambda e: (e.at_tick, e.action))
+        self.crashed: set = set()
+        self.stalled: Dict[str, int] = {}  # node -> remaining stalled ticks
+        self.tick = 0
+        self.proposals: List[dict] = []  # completions from 'propose' events
+
+    # ------------------------------------------------------------- actions
+    def _isolate(self, node: str) -> None:
+        others = [n for n in self.ids if n != node]
+        if others:
+            self.net.partition({node}, set(others))
+
+    def _reconnect(self, node: str) -> None:
+        self.net._down = {(a, b) for (a, b) in self.net._down
+                          if a != node and b != node}
+
+    def _mark(self, node: str, up: bool) -> None:
+        r = self.ids.index(node)
+        for nid, nd in self.nodes.items():
+            if nid != node and nid not in self.crashed:
+                nd.set_alive(r, up)
+
+    def _apply(self, ev: ChaosEvent) -> None:
+        a, args = ev.action, dict(ev.args)
+        info: dict = {}
+        if a == "crash":
+            node = args["node"]
+            self.crashed.add(node)
+            self._isolate(node)
+            info["dropped"] = (self.net.drop_pending(src=node)
+                               + self.net.drop_pending(dst=node))
+            # survivors' failure detectors flip the node down after a
+            # detection delay; model it as a scheduled mark_down
+            detect = int(args.get("detect_after", 0))
+            if detect >= 0:
+                self._pending.append(ChaosEvent(
+                    ev.at_tick + detect, "mark_down", {"node": node}))
+                self._pending.sort(key=lambda e: (e.at_tick, e.action))
+        elif a == "recover":
+            node = args["node"]
+            self.crashed.discard(node)
+            self._reconnect(node)
+            self._mark(node, True)
+            nd = self.nodes[node]
+            if hasattr(nd, "request_sync"):
+                nd.request_sync()
+        elif a == "partition":
+            sides = [set(s) for s in args["sides"]]
+            named = set().union(*sides) - {"__REST__"}
+            sides = [({n for n in self.ids if n not in named}
+                      if s == {"__REST__"} else s) for s in sides]
+            self.net.partition(*sides)
+        elif a == "heal":
+            self.net.heal()
+        elif a == "slow_node":
+            self.net.set_slow_node(args["node"],
+                                   int(args.get("extra_rounds", 0)))
+        elif a == "fsync_stall":
+            self.stalled[args["node"]] = int(args.get("ticks", 1))
+        elif a == "cut_region":
+            info["cut"] = self.net.cut_region(args["region"])
+        elif a == "heal_region":
+            self.net.heal_region(args["region"])
+        elif a == "set_delay":
+            self.net.set_delay(args["src"], args["dst"],
+                               int(args["rounds"]),
+                               both_ways=bool(args.get("both_ways", True)))
+        elif a == "drop_pending":
+            info["dropped"] = self.net.drop_pending(
+                args.get("src"), args.get("dst"))
+        elif a == "mark_down":
+            self._mark(args["node"], False)
+        elif a == "mark_up":
+            self._mark(args["node"], True)
+        elif a == "propose":
+            node, name = args["node"], args["group"]
+            payload = str(args["payload"]).encode()
+            done = {"tick": self.tick, "group": name,
+                    "payload": args["payload"], "resp": None,
+                    "resp_tick": None}
+            self.proposals.append(done)
+
+            def cb(_rid, resp, _d=done):
+                _d["resp"] = None if resp is None else resp.decode(
+                    "utf-8", "replace")
+                _d["resp_tick"] = self.tick
+
+            rid = self.nodes[node].propose(name, payload, cb)
+            info["rid"] = rid
+        self.log.record(ev.at_tick, a, args, **info)
+
+    # ---------------------------------------------------------------- loop
+    def run(self, ticks: int,
+            on_tick: Optional[Callable[[int], None]] = None) -> ChaosLog:
+        """Advance ``ticks`` ticks, applying due events before each one.
+        ``on_tick(t)`` (if given) runs after each tick+pump — the hook the
+        geo soak uses to timestamp commits."""
+        for _ in range(ticks):
+            while self._pending and self._pending[0].at_tick <= self.tick:
+                self._apply(self._pending.pop(0))
+            for nid, nd in self.nodes.items():
+                if nid in self.crashed:
+                    continue
+                left = self.stalled.get(nid)
+                if left is not None:
+                    if left <= 1:
+                        del self.stalled[nid]
+                    else:
+                        self.stalled[nid] = left - 1
+                    continue  # tick thread blocked in fsync
+                nd.tick()
+            self.net.pump()
+            if on_tick is not None:
+                on_tick(self.tick)
+            self.tick += 1
+        return self.log
+
+
+# -------------------------------------------------------------------- proc
+class ProcChaosRunner:
+    """Execute a schedule against REAL OS processes.
+
+    ``procs`` maps node id -> a handle with a ``proc`` (``subprocess.
+    Popen``) attribute and a ``sigkill()`` method (the ``Worker`` class of
+    ``tests/test_modeb_multiprocess.py``).  ``restart`` is a callable
+    ``(node_id) -> handle`` used by ``recover``.  ``at_tick`` maps to wall
+    clock as ``at_tick * tick_s`` seconds from :meth:`run` start.  Fault
+    vocabulary maps to OS primitives: crash → SIGKILL, recover → restart
+    from the node's own WAL dir, fsync_stall → SIGSTOP for the scaled
+    duration then SIGCONT (a frozen process is indistinguishable from one
+    blocked in ``os.fsync``).  Partitions need netfilter and are out of
+    scope here — validate() rejects them up front.
+    """
+
+    def __init__(self, procs: Dict[str, object], schedule: ChaosSchedule,
+                 restart: Optional[Callable[[str], object]] = None,
+                 tick_s: float = 0.05):
+        schedule.validate(PROC_ACTIONS)
+        self.procs = procs
+        self.schedule = schedule
+        self.restart = restart
+        self.tick_s = tick_s
+        self.log = ChaosLog(schedule)
+        self._stopped: Dict[str, float] = {}  # node -> resume deadline
+
+    def _apply(self, ev: ChaosEvent) -> None:
+        a, args = ev.action, dict(ev.args)
+        info: dict = {}
+        if a == "crash":
+            self.procs[args["node"]].sigkill()
+        elif a == "recover":
+            if self.restart is None:
+                raise RuntimeError("recover needs a restart factory")
+            self.procs[args["node"]] = self.restart(args["node"])
+        elif a == "fsync_stall":
+            node = args["node"]
+            self.procs[node].proc.send_signal(signal.SIGSTOP)
+            self._stopped[node] = (time.monotonic()
+                                   + int(args.get("ticks", 1)) * self.tick_s)
+        elif a == "propose":
+            h = self.procs[args["node"]]
+            h.send(f"propose {args['group']} "
+                   f"{str(args['payload']).encode().hex()}")
+        self.log.record(ev.at_tick, a, args, **info)
+
+    def run(self) -> ChaosLog:
+        pending = sorted(self.schedule.events, key=lambda e: e.at_tick)
+        start = time.monotonic()
+        while pending or self._stopped:
+            now = time.monotonic()
+            for node, deadline in list(self._stopped.items()):
+                if now >= deadline:
+                    del self._stopped[node]
+                    try:
+                        self.procs[node].proc.send_signal(signal.SIGCONT)
+                    except (OSError, ProcessLookupError):
+                        pass
+            if pending and now - start >= pending[0].at_tick * self.tick_s:
+                self._apply(pending.pop(0))
+                continue
+            time.sleep(min(self.tick_s, 0.05))
+        return self.log
+
+
+# ---------------------------------------------------------- stock schedules
+def coordinator_crash(coord: str = "N0", crash_at: int = 30,
+                      recover_at: int = 160, detect_after: int = 4,
+                      seed: int = 0) -> ChaosSchedule:
+    """Kill the initial coordinator, re-elect, then bring it back."""
+    return ChaosSchedule("coordinator_crash", [
+        ChaosEvent(crash_at, "crash",
+                   {"node": coord, "detect_after": detect_after}),
+        ChaosEvent(recover_at, "recover", {"node": coord}),
+    ], seed=seed)
+
+
+def region_outage(region: str = "use", cut_at: int = 40,
+                  heal_at: int = 220, seed: int = 0) -> ChaosSchedule:
+    """Cut a whole geo region (after ``apply_geo``), later heal it."""
+    return ChaosSchedule("region_outage", [
+        ChaosEvent(cut_at, "cut_region", {"region": region}),
+        ChaosEvent(heal_at, "heal_region", {"region": region}),
+    ], seed=seed)
+
+
+def rolling_stall(nodes: Iterable[str], every: int = 40, ticks: int = 12,
+                  seed: int = 0) -> ChaosSchedule:
+    """WAL-fsync stalls sweep the cluster one node at a time."""
+    evs = [ChaosEvent(10 + i * every, "fsync_stall",
+                      {"node": n, "ticks": ticks})
+           for i, n in enumerate(nodes)]
+    return ChaosSchedule("rolling_stall", evs, seed=seed)
+
+
+def partition_flap(minority: str = "N0", period: int = 50, flaps: int = 3,
+                   detect_after: int = 4, seed: int = 0) -> ChaosSchedule:
+    """Repeatedly isolate and re-admit one node (asymmetric flapping —
+    the classic dueling-coordinator inducer)."""
+    evs: List[ChaosEvent] = []
+    for i in range(flaps):
+        t = 20 + i * period
+        evs.append(ChaosEvent(t, "partition",
+                              {"sides": [[minority],
+                                         ["__REST__"]]}))
+        evs.append(ChaosEvent(t + detect_after, "mark_down",
+                              {"node": minority}))
+        evs.append(ChaosEvent(t + period // 2, "heal", {}))
+        evs.append(ChaosEvent(t + period // 2, "mark_up",
+                              {"node": minority}))
+    return ChaosSchedule("partition_flap", evs, seed=seed)

@@ -1,0 +1,250 @@
+"""Consecutive-ballot fast re-election (``fast_elect`` tick flag).
+
+A candidate whose promised ballot already equals the group's maximum may
+take over at the successor ballot WITHOUT a prepare round: every chosen
+value a classical prepare could have surfaced is already in its mirrors
+(carryover from all member rows), and any accept that races the takeover
+is protected by the acceptor-side conflict refusal + coordinator adoption
+with a consecutive ballot bump (see ``ops/tick.py`` docstring).  These
+tests pin down each piece:
+
+* Mode A: fast bootstrap, failover carryover, and the refusal→adoption
+  path resolving a conflicting accepted value without a lost update;
+* Mode B over SimNet: the actual win — a fast takeover completes in
+  fewer ticks than the classical prepare round trip (the A/B the geo
+  soak reports as time-to-new-coordinator);
+* a partition-flap chaos soak asserting the S1 per-slot safety ledger.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from gigapaxos_tpu.config import GigapaxosTpuConfig
+from gigapaxos_tpu.models.replicable import KVApp
+from gigapaxos_tpu.modeb import ModeBNode
+from gigapaxos_tpu.ops.tick import TickInbox, make_inbox, paxos_tick
+from gigapaxos_tpu.paxos import state as st
+from gigapaxos_tpu.testing.chaos import (ChaosEvent, SimChaosRunner,
+                                         partition_flap)
+from gigapaxos_tpu.testing.simnet import SimNet
+
+IDS = ["N0", "N1", "N2"]
+
+
+def mk(R=3, G=4, W=8):
+    s = st.init_state(R, G, W)
+    rows = np.arange(G, dtype=np.int32)
+    return st.create_groups(s, rows, np.ones((G, R), bool))
+
+
+def inbox(R=3, G=4, P=4, reqs=(), alive=None):
+    ib = make_inbox(R, G, P)
+    req = np.array(ib.req)
+    slot_ctr = {}
+    for r, g, rid in reqs:
+        p = slot_ctr.get((r, g), 0)
+        req[r, p, g] = rid
+        slot_ctr[(r, g)] = p + 1
+    al = np.ones(R, bool) if alive is None else np.array(alive, bool)
+    return TickInbox(jnp.asarray(req), jnp.asarray(ib.stop), jnp.asarray(al))
+
+
+def tick_fast(s, ib):
+    # static args positionally: own_row, exec_budget, group_axis, fast_elect
+    return paxos_tick(s, ib, -1, 0, None, True)
+
+
+def executed_ids(out, r, g):
+    row = np.array(out.exec_req[r, :, g])
+    n = int(out.exec_count[r, g])
+    return [int(x) for x in row if x != 0][: n + 1]
+
+
+# ------------------------------------------------------------------ Mode A
+def test_fast_bootstrap_elects_and_commits_same_tick():
+    s = mk()
+    s, out = tick_fast(s, inbox(reqs=[(0, 1, 7)]))
+    assert np.all(np.array(out.coord_id) == 0)
+    assert np.all(np.array(s.coord_active[0]))
+    # fast takeover, not a prepare round
+    assert np.all(np.array(s.coord_fast[0]))
+    assert not np.any(np.array(s.coord_preparing))
+    for r in range(3):
+        assert executed_ids(out, r, 1) == [7]
+
+
+def test_fast_failover_carries_accepted_value():
+    """A pvalue accepted under the dead coordinator's ballot but never
+    decided must survive a fast takeover (the combinePValuesOntoProposals
+    property, here provided by member-row carryover instead of promises)."""
+    s = mk()
+    s, out = tick_fast(s, inbox(reqs=[(0, 0, 31)]))
+    assert executed_ids(out, 0, 0) == [31]
+    # surgically place an accepted-but-undecided pvalue at slot 1 on the
+    # two survivor rows, stamped with the dead coordinator's ballot
+    W = s.window
+    j = 1 % W
+    bal = int(np.array(s.coord_bnum[0, 0]))
+    acc_req = np.array(s.acc_req)
+    acc_slot = np.array(s.acc_slot)
+    acc_bnum = np.array(s.acc_bnum)
+    acc_bcoord = np.array(s.acc_bcoord)
+    # only on row 2 — NOT on the future taker, so the value can only
+    # survive via the fast path's all-member-row carryover
+    acc_req[2, j, 0] = 99
+    acc_slot[2, j, 0] = 1
+    acc_bnum[2, j, 0] = bal
+    acc_bcoord[2, j, 0] = 0
+    s = s._replace(acc_req=jnp.asarray(acc_req), acc_slot=jnp.asarray(acc_slot),
+                   acc_bnum=jnp.asarray(acc_bnum),
+                   acc_bcoord=jnp.asarray(acc_bcoord))
+    # coordinator dies; replica 1 fast-takes over and must re-propose 99
+    s, out = tick_fast(s, inbox(alive=[False, True, True]))
+    assert int(out.coord_id[0]) == 1
+    assert bool(np.array(s.coord_fast[1, 0]))
+    seq = executed_ids(out, 1, 0)
+    assert 99 in seq, seq
+    assert executed_ids(out, 2, 0) == seq
+
+
+def test_fast_conflict_converges_on_single_value():
+    """Refusal + demote liveness: the fast coordinator proposed its own
+    value at a slot where a rejoining acceptor holds a DIFFERENT value
+    accepted under the old (lower) ballot by a MINORITY (never chosen).
+    The acceptor's refusal blocks the fast quorum; the coordinator proves
+    the refusal from mirrors, demotes to a full prepare at the bumped
+    ballot, and the slot converges on exactly ONE value everywhere (the
+    max-ballot pvalue — the coordinator's own, since the minority value
+    was never chosen).  No divergence, no stall."""
+    s = mk()
+    s, out = tick_fast(s, inbox(reqs=[(0, 0, 31)]))
+    old_bal = int(np.array(s.coord_bnum[0, 0]))
+    # rows 0 and 2 die; row 1 fast-takes over and proposes 50 at slot 1,
+    # but with 1/3 alive it cannot decide — the proposal stays in flight
+    s, out = tick_fast(s, inbox(reqs=[(1, 0, 50)],
+                                alive=[False, True, False]))
+    assert bool(np.array(s.coord_fast[1, 0]))
+    assert int(out.exec_count[1, 0]) == 0
+    assert 50 in list(np.array(s.prop_req[1, :, 0]))
+    # while row 1 was taking over, row 2 had accepted 99 at slot 1 under
+    # the OLD coordinator's ballot (an accept frame that raced the crash)
+    W = s.window
+    j = 1 % W
+    acc_req = np.array(s.acc_req)
+    acc_slot = np.array(s.acc_slot)
+    acc_bnum = np.array(s.acc_bnum)
+    acc_bcoord = np.array(s.acc_bcoord)
+    acc_req[2, j, 0] = 99
+    acc_slot[2, j, 0] = 1
+    acc_bnum[2, j, 0] = old_bal
+    acc_bcoord[2, j, 0] = 0
+    s = s._replace(acc_req=jnp.asarray(acc_req), acc_slot=jnp.asarray(acc_slot),
+                   acc_bnum=jnp.asarray(acc_bnum),
+                   acc_bcoord=jnp.asarray(acc_bcoord))
+    # row 2 rejoins: its refusal blocks 50 at the fast ballot; the proven
+    # refusal demotes row 1 to a classical prepare, which re-proposes the
+    # max-ballot pvalue — both replicas then decide the SAME single value
+    seqs = {}
+    for _ in range(4):
+        s, out = tick_fast(s, inbox(alive=[False, True, True]))
+        for r in (1, 2):
+            seqs.setdefault(r, []).extend(
+                x for x in executed_ids(out, r, 0) if x)
+    assert len(seqs[1]) == 1, seqs  # exactly one value decided for slot 1
+    assert seqs[2] == seqs[1], seqs  # identical on every replica
+    # liveness: the refusal did not wedge the group
+    assert int(np.array(s.exec_slot[1, 0])) >= 2
+    # the fast reign ended (demoted to a classical, prepared reign)
+    assert not bool(np.array(s.coord_fast[1, 0]))
+
+
+def test_fast_flag_off_keeps_legacy_graph():
+    """Default-off parity: without fast_elect the same schedule elects via
+    prepare and coord_fast never sets."""
+    s = mk()
+    s, out = paxos_tick(s, inbox())
+    alive = [False, True, True]
+    s, out = paxos_tick(s, inbox(reqs=[(1, 0, 42)], alive=alive))
+    assert executed_ids(out, 1, 0) == [42]
+    assert not np.any(np.array(s.coord_fast))
+
+
+# ------------------------------------------------------------------ Mode B
+def _build_cluster(fast, seed=1):
+    net = SimNet(seed=seed)
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 8
+    cfg.paxos.window = 8
+    cfg.paxos.fast_reelection = fast
+    apps = {n: KVApp() for n in IDS}
+    nodes = {n: ModeBNode(cfg, IDS, n, apps[n], net.messenger(n),
+                          anti_entropy_every=8) for n in IDS}
+    for nd in nodes.values():
+        nd.create_group("svc", [0, 1, 2])
+    return net, nodes, apps
+
+
+def _ticks_to_failover(fast):
+    net, nodes, apps = _build_cluster(fast)
+
+    def spin(k, only=None):
+        for _ in range(k):
+            for nid, nd in nodes.items():
+                if only is None or nid in only:
+                    nd.tick()
+            net.pump()
+
+    done = []
+    nodes["N0"].propose("svc", b"PUT a 1", lambda r, x: done.append(x))
+    spin(40)
+    assert done == [b"OK"]
+    row = nodes["N1"].rows.row("svc")
+    assert int(nodes["N1"]._coord_view[row]) == 0
+    net.partition({"N0"}, {"N1", "N2"})
+    for nid in ("N1", "N2"):
+        nodes[nid].set_alive(0, False)
+    done2 = []
+    nodes["N1"].propose("svc", b"PUT b 2", lambda r, x: done2.append(x))
+    t_coord = t_commit = None
+    for t in range(1, 101):
+        spin(1, only=("N1", "N2"))
+        if t_coord is None and int(nodes["N1"]._coord_view[row]) == 1:
+            t_coord = t
+        if done2:
+            t_commit = t
+            break
+    assert done2 == [b"OK"]
+    return t_coord, t_commit
+
+
+def test_modeb_fast_takeover_beats_full_prepare():
+    """The headline A/B: over frames, a prepare round costs extra RTTs; a
+    consecutive-ballot takeover elects locally.  Fast must be strictly
+    quicker on BOTH time-to-coordinator and time-to-first-commit."""
+    full_coord, full_commit = _ticks_to_failover(fast=False)
+    fast_coord, fast_commit = _ticks_to_failover(fast=True)
+    assert fast_coord < full_coord, (fast_coord, full_coord)
+    assert fast_commit < full_commit, (fast_commit, full_commit)
+    assert fast_coord == 1  # same-tick takeover
+
+
+def test_flap_soak_fast_stays_safe():
+    """Partition flapping (the dueling-coordinator inducer) with fast
+    re-election on: the per-slot ledger must stay S1-clean and all
+    replicas converge after the last heal."""
+    net, nodes, apps = _build_cluster(fast=True, seed=7)
+    sched = partition_flap("N0", period=40, flaps=3)
+    sched.events = sched.events + [
+        ChaosEvent(5 + 10 * i, "propose",
+                   {"node": IDS[i % 3], "group": "svc",
+                    "payload": f"PUT k{i} v{i}"})
+        for i in range(12)
+    ]
+    runner = SimChaosRunner(net, nodes, sched)
+    runner.run(320)
+    runner.ledger.assert_safe()
+    ok = [p for p in runner.proposals if p["resp"] == "OK"]
+    assert len(ok) >= 6, runner.proposals  # majority-side proposals commit
+    dbs = [apps[n].db.get("svc", {}) for n in IDS]
+    assert dbs[0] == dbs[1] == dbs[2], dbs

@@ -1,0 +1,184 @@
+"""Serving-cell capacity sweep: closed-loop req/s at 1 -> 2 -> 4 cells.
+
+The multi-core claim of the cells plane (ISSUE PR 8): throughput scales
+with cell count because each cell is its own process on its own core.
+This bench measures it honestly:
+
+* one ``CellSupervisor`` per rung with ``n_cells`` workers, groups spread
+  over the cells by the static hash;
+* a closed-loop threaded client workload (sync ``request`` per thread —
+  the TESTPaxos capacity methodology's closed loop, not open-loop floods);
+* **per-cell core attribution** from ``/proc/<pid>/stat`` utime+stime
+  deltas over the measurement window (``cores_busy[k]`` ~ 1.0 means cell
+  k burned a full core), so a single-core box cannot silently fake a
+  scaling win — the attribution shows every cell time-slicing one core.
+
+On a 1-core host the sweep still runs and records honest numbers (the
+PR-5 precedent: artifacts state their environment instead of gating on
+it); the >=1.7x knee assert lives in the multicore-marked test
+(tests/test_cells.py) and only fires on real multi-core boxes.
+
+Run: ``python benchmarks/cells_capacity.py [--seconds 5] [--out path]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _cpu_seconds(pid: int) -> float:
+    """utime+stime of one process, in seconds (no children)."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            data = f.read()
+        rest = data[data.rindex(")") + 2:].split()
+        ticks = int(rest[11]) + int(rest[12])  # fields 14+15: utime+stime
+        return ticks / os.sysconf("SC_CLK_TCK")
+    except (OSError, ValueError):
+        return 0.0
+
+
+def measure_cells(base_dir: str, n_cells: int, seconds: float = 5.0,
+                  n_names: int = 8, threads: int = 4,
+                  warmup_s: float = 1.0) -> dict:
+    """One sweep rung: spin up ``n_cells``, drive a closed loop, return
+    req/s plus per-cell core attribution."""
+    from gigapaxos_tpu.cells.supervisor import CellSupervisor
+    from gigapaxos_tpu.config import CellsConfig
+
+    cc = CellsConfig(
+        enabled=True, n_cells=n_cells, n_actives=3, n_reconfigurators=1,
+        pin_cores=(os.cpu_count() or 1) >= 4,
+    )
+    sup = CellSupervisor(base_dir, cells=cc,
+                         paxos_overrides={"max_groups": 32}).start()
+    try:
+        admin = sup.make_client()
+        names = [f"b{i}" for i in range(n_names)]
+        for n in names:
+            assert admin.create(n).get("ok"), n
+        for i, n in enumerate(names):
+            assert admin.request(n, f"PUT w {i}".encode()) == b"OK"
+
+        stop_at = [0.0]
+        counts = [0] * threads
+        errors = [0]
+
+        def loop(t: int) -> None:
+            c = sup.make_client()
+            try:
+                i = t
+                while time.monotonic() < stop_at[0]:
+                    n = names[i % n_names]
+                    try:
+                        c.request(n, f"PUT k{t} {i}".encode(), timeout=30)
+                        counts[t] += 1
+                    except Exception:
+                        errors[0] += 1
+                    i += threads
+            finally:
+                c.close()
+
+        # warmup: prime route caches + per-worker JIT paths
+        stop_at[0] = time.monotonic() + warmup_s
+        ws = [threading.Thread(target=loop, args=(t,)) for t in range(threads)]
+        for w in ws:
+            w.start()
+        for w in ws:
+            w.join()
+        counts[:] = [0] * threads
+        errors[0] = 0
+
+        pids = {k: h.proc.pid for k, h in sup.cells.items()}
+        cpu0 = {k: _cpu_seconds(p) for k, p in pids.items()}
+        stop_at[0] = time.monotonic() + seconds
+        t0 = time.monotonic()
+        ws = [threading.Thread(target=loop, args=(t,)) for t in range(threads)]
+        for w in ws:
+            w.start()
+        for w in ws:
+            w.join()
+        dt = time.monotonic() - t0
+        cores_busy = {
+            k: round((_cpu_seconds(p) - cpu0[k]) / dt, 3)
+            for k, p in pids.items()
+        }
+        total = sum(counts)
+        admin.close()
+        return {
+            "n_cells": n_cells,
+            "reqs_per_s": round(total / dt, 1),
+            "requests": total,
+            "errors": errors[0],
+            "seconds": round(dt, 2),
+            "threads": threads,
+            "names": n_names,
+            "cores_busy": [cores_busy[k] for k in sorted(cores_busy)],
+            "pinned": cc.pin_cores,
+        }
+    finally:
+        sup.stop()
+
+
+def sweep(out: str, seconds: float, rungs=(1, 2, 4)) -> dict:
+    host_cores = os.cpu_count() or 1
+    rows = []
+    for n in rungs:
+        base = tempfile.mkdtemp(prefix=f"gptpu_cells_{n}_")
+        try:
+            r = measure_cells(base, n, seconds=seconds)
+        finally:
+            shutil.rmtree(base, ignore_errors=True)
+        rows.append(r)
+        print(f"cells={n}: {r['reqs_per_s']} req/s, "
+              f"cores_busy={r['cores_busy']}", file=sys.stderr)
+    base_rate = rows[0]["reqs_per_s"] or 1.0
+    results = {
+        "generated_unix": int(time.time()),
+        "environment": {"cpu_count": host_cores,
+                        "python": sys.version.split()[0]},
+        "metric": "cells_closed_loop_reqs_per_s",
+        "sweep": rows,
+        "speedup_vs_1_cell": [round(r["reqs_per_s"] / base_rate, 2)
+                              for r in rows],
+        # the >=1.7x knee at 2 cells is a MULTI-CORE claim; on fewer cores
+        # the sweep documents the time-slicing honestly instead
+        "multi_core_box": host_cores >= 4,
+        "note": ("single-shared-core host: all cells time-slice one core, "
+                 "so speedup ~1.0x is the expected honest reading; see "
+                 "PARITY.md 'Multi-core measurement methodology'"
+                 if host_cores < 4 else
+                 "knee gate (>=1.7x at 2 cells) asserted by the multicore "
+                 "test tier"),
+    }
+    with open(out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps({"written": out,
+                      "reqs_per_s": [r["reqs_per_s"] for r in rows],
+                      "speedup": results["speedup_vs_1_cell"]}))
+    return results
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=5.0)
+    ap.add_argument("--rungs", default="1,2,4")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "results_capacity_cells_pr8.json"))
+    args = ap.parse_args()
+    sweep(args.out, args.seconds,
+          tuple(int(x) for x in args.rungs.split(",")))
+
+
+if __name__ == "__main__":
+    main()

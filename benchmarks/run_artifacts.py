@@ -289,6 +289,22 @@ def bench_chaos_replay() -> dict:
     }
 
 
+def bench_cells_capacity() -> dict:
+    """Serving-cells capacity sweep (benchmarks/cells_capacity.py):
+    refreshes results_capacity_cells_pr8.json (1 -> 2 -> 4 cells with
+    per-cell core attribution) and surfaces the headline here."""
+    r = _script(["benchmarks/cells_capacity.py", "--seconds", "4"],
+                timeout=3600)[-1]
+    return {
+        "metric": "cells_closed_loop_reqs_per_s_sweep",
+        "value": r["reqs_per_s"][-1],
+        "unit": "req/s (largest rung)",
+        "reqs_per_s": r["reqs_per_s"],
+        "speedup_vs_1_cell": r["speedup"],
+        "artifact": r.get("written"),
+    }
+
+
 def _best_of(fn, n: int) -> dict:
     """Run a bench ``n`` times and keep the best run.  The box these
     artifacts are produced on is a single shared core — interference can
@@ -348,6 +364,8 @@ def main() -> None:
     # chaos/WAN scenario plane (PR 6): region-loss SLO + replay contract
     run("geo_soak", bench_geo_soak)
     run("chaos_replay", bench_chaos_replay)
+    # serving-cell plane (PR 8): multi-core host capacity sweep
+    run("cells_capacity", bench_cells_capacity)
 
     out = args.out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),

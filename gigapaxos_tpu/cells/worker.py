@@ -1,0 +1,282 @@
+"""Serving-cell worker process: one crash-isolated Mode A cluster per core.
+
+A cell is a full :class:`~gigapaxos_tpu.node.InProcessCluster` (dense-device
+data plane + RC plane) running in its own OS process, pinned to one CPU
+core, owning a static shard of the group space (``routing.cell_of``), with
+its own WAL directories and transport endpoints.  The process is spawned and
+supervised by :class:`~gigapaxos_tpu.cells.supervisor.CellSupervisor`; node
+ids are cell-qualified (``c{k}.AR0``, ``c{k}.RC0``) so every cell's
+endpoints coexist in one merged NodeConfig for clients.
+
+Spawned as ``python -m gigapaxos_tpu.cells.worker '<spec json>'`` with::
+
+  {"cell": 0, "n_cells": 2,
+   "actives": {"c0.AR0": ["127.0.0.1", p]},        # THIS cell's nodes only
+   "reconfigurators": {"c0.RC0": ["127.0.0.1", p]},
+   "peers": {"c1.AR0": [...], "SUP": [...]},       # other cells + supervisor
+   "wal_dir": "...", "rc_wal_dir": "...",
+   "core": 0,                                       # sched_setaffinity pin
+   "edge": ["127.0.0.1", p],                        # SO_REUSEPORT shared edge
+   "overrides": {"name": 1},                        # migrated-name directory
+   "paxos": {"max_groups": 16},                     # cfg.paxos attr overrides
+   "cfg": {"native_journal": true},                 # top-level cfg overrides
+   "ledger": true,                                  # record (r,name,slot,rid)
+   "drain_timeout_s": 10.0}
+
+Line protocol on stdin/stdout (the Mode B worker's idiom, extended):
+
+  create <name>                 -> "created <name>" (direct local create)
+  propose <name> <hex>          -> (async) "resp <rid> <hex|NONE>"
+  db [r]                        -> "db <json>" (replica r's app state)
+  stats                         -> "stats <json>"
+  ledger                        -> "ledger <json>" (execution observations)
+  drain                         -> "drained ok|timeout"
+  override <name> <cell>        -> "override_ok <name>" (edge routing)
+  migrate_out <name>            -> "migrated_out <name> <epoch> <hex>"
+  migrate_in <name> <ep> <hex>  -> "migrated_in <name> <ep>"
+  migrate_drop <name> <ep>      -> "migrate_dropped <name>"
+  exit                          -> graceful shutdown, process exits
+
+SIGTERM triggers the graceful path (drain in-flight tick, flush + close
+WAL, close transports); SIGKILL emulates a core crash — the supervisor
+restarts the cell against the same WAL dirs and replay rebuilds it.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# --------------------------------------------------------------- S1 ledger
+#: execution observations [r, name, slot, rid, is_stop] — appended by the
+#: class-level `_execute_one` wrap BELOW the WAL replay, so a restarted
+#: worker's ledger covers replayed history too (tests feed pre-kill and
+#: post-restart dumps into testing.chaos.SafetyLedger and assert no
+#: (name, slot) ever decided two rids across the crash)
+_LEDGER: list = []
+_LEDGER_LOCK = threading.Lock()
+
+
+def _install_ledger() -> None:
+    from gigapaxos_tpu.paxos import manager as mgr_mod
+
+    orig = mgr_mod.PaxosManager._execute_one
+
+    def _observed(self, r, row, name, rid, slot, is_stop):
+        with _LEDGER_LOCK:
+            _LEDGER.append([int(r), str(name), int(slot), int(rid),
+                            bool(is_stop)])
+        return orig(self, r, row, name, rid, slot, is_stop)
+
+    mgr_mod.PaxosManager._execute_one = _observed
+
+
+def _pin_core(core) -> None:
+    if core is None or not hasattr(os, "sched_setaffinity"):
+        return
+    try:
+        ncpu = os.cpu_count() or 1
+        os.sched_setaffinity(0, {int(core) % ncpu})
+    except OSError:
+        pass  # cgroup-restricted masks: run unpinned rather than die
+
+
+def main() -> None:
+    spec = json.loads(sys.argv[1])
+    cell = int(spec["cell"])
+    n_cells = int(spec.get("n_cells", 1))
+    _pin_core(spec.get("core"))
+    if spec.get("ledger"):
+        _install_ledger()
+
+    from gigapaxos_tpu.config import GigapaxosTpuConfig
+    from gigapaxos_tpu.models.replicable import KVApp
+    from gigapaxos_tpu.net.failure_detection import FailureDetection
+    from gigapaxos_tpu.net.messenger import Messenger
+    from gigapaxos_tpu.node import InProcessCluster
+    from gigapaxos_tpu.reconfiguration import packets as pkt
+
+    from .routing import cell_of
+
+    cfg = GigapaxosTpuConfig()
+    for k, v in (spec.get("paxos") or {}).items():
+        setattr(cfg.paxos, k, v)
+    for k, v in (spec.get("cfg") or {}).items():
+        setattr(cfg, k, v)
+    cfg.nodes.actives = {n: tuple(a) for n, a in spec["actives"].items()}
+    cfg.nodes.reconfigurators = {
+        n: tuple(a) for n, a in spec["reconfigurators"].items()
+    }
+
+    out_lock = threading.Lock()
+
+    def emit(line: str) -> None:
+        with out_lock:
+            sys.stdout.write(line + "\n")
+            sys.stdout.flush()
+
+    try:
+        cluster = InProcessCluster(
+            cfg, KVApp,
+            replicas_per_name=len(cfg.nodes.actives),
+            rc_group_size=len(cfg.nodes.reconfigurators),
+            wal_dir=spec["wal_dir"],
+            rc_wal_dir=spec["rc_wal_dir"],
+        )
+    except Exception as e:  # startup must be observable, not a silent death
+        emit(f"startup_failed {type(e).__name__}: {e}")
+        sys.exit(1)
+
+    # other cells' endpoints + the supervisor: reachable for edge forwarding
+    # and control pings, but NOT part of this cell's consensus topology
+    for nid, (host, port) in (spec.get("peers") or {}).items():
+        cluster.nodemap.add(nid, host, int(port))
+
+    active_ids = sorted(cluster.actives)
+    ar0 = cluster.actives[active_ids[0]]
+    # answering the supervisor's EWMA heartbeats only needs the PING handler
+    # a (non-monitoring) detector registers on AR0's messenger
+    fd = FailureDetection(ar0.m, monitored=())
+
+    # migrated-name directory for edge routing, updated by `override` lines
+    overrides: dict = {str(k): int(v)
+                       for k, v in (spec.get("overrides") or {}).items()}
+
+    # ------------------------------------------------- SO_REUSEPORT edge
+    # every cell binds the SAME edge port; the kernel spreads incoming
+    # client connections across cells, and a mis-routed first request is
+    # forwarded to its owner cell, which answers the client directly
+    # (reply_to + client_addr registration — zero extra hop once cached)
+    edge_m = None
+    if spec.get("edge"):
+        host, port = spec["edge"]
+        edge_m = Messenger(f"c{cell}.EDGE", (host, int(port)),
+                           cluster.nodemap, reuse_port=True)
+
+        def on_edge_request(sender: str, p: dict) -> None:
+            name = p.get("name", "")
+            owner = overrides.get(name)
+            if owner is None:
+                owner = cell_of(name, n_cells)
+            p.setdefault("reply_to", p.get("sender") or sender)
+            if owner == cell:
+                ar0._on_app_request(sender, p)
+            else:
+                edge_m.send(f"c{owner}.AR0", p)
+
+        edge_m.register(pkt.APP_REQUEST, on_edge_request)
+
+    cluster.install_sigterm(
+        drain_timeout_s=float(spec.get("drain_timeout_s", 10.0)),
+        on_exit=(edge_m.close if edge_m is not None else None),
+    )
+    emit("ready")
+
+    m = cluster.manager
+    coord = cluster.coordinator
+
+    def pump() -> None:
+        cluster.kick()
+        time.sleep(0.002)
+
+    for line in sys.stdin:
+        parts = line.strip().split(" ")
+        if not parts or not parts[0]:
+            continue
+        cmd = parts[0]
+        try:
+            if cmd == "create":
+                coord.create_replica_group(parts[1], 0, b"", active_ids)
+                emit(f"created {parts[1]}")
+            elif cmd == "propose":
+                name, payload = parts[1], bytes.fromhex(parts[2])
+                epoch = coord.current_epoch(name)
+                if epoch is None:
+                    emit(f"err propose no_epoch:{name}")
+                    continue
+
+                def cb(rid, resp):
+                    emit("resp %s %s" % (
+                        rid, resp.hex() if resp is not None else "NONE"))
+
+                if coord.coordinate_request(name, epoch, payload, cb) is None:
+                    emit(f"err propose rejected:{name}")
+                cluster.kick()
+            elif cmd == "db":
+                r = int(parts[1]) if len(parts) > 1 else 0
+                emit("db " + json.dumps(m.apps[r].db, sort_keys=True))
+            elif cmd == "stats":
+                emit("stats " + json.dumps({
+                    "pid": os.getpid(), "cell": cell,
+                    "tick": int(m.tick_num),
+                    "rc_tick": int(cluster.rc_manager.tick_num),
+                    "groups": len(list(m.rows.names())),
+                    "overrides": dict(overrides),
+                }, sort_keys=True))
+            elif cmd == "ledger":
+                with _LEDGER_LOCK:
+                    emit("ledger " + json.dumps(_LEDGER))
+            elif cmd == "drain":
+                ok = cluster.drain(float(spec.get("drain_timeout_s", 10.0)))
+                emit("drained " + ("ok" if ok else "timeout"))
+            elif cmd == "override":
+                name, dst = parts[1], int(parts[2])
+                if dst == cell:
+                    overrides.pop(name, None)
+                else:
+                    overrides[name] = dst
+                emit(f"override_ok {name}")
+            elif cmd == "migrate_out":
+                name = parts[1]
+                epoch = coord.current_epoch(name)
+                if epoch is None:
+                    emit(f"migrate_err {name} no_epoch")
+                    continue
+                coord.stop_replica_group(name, epoch, lambda ok: None)
+                blob, ticks = coord.get_final_state(name, epoch), 0
+                while blob is None and ticks < 1024:
+                    pump()
+                    ticks += 1
+                    blob = coord.get_final_state(name, epoch)
+                if blob is None:
+                    emit(f"migrate_err {name} drain_timeout")
+                else:
+                    emit(f"migrated_out {name} {epoch} {blob.hex()}")
+            elif cmd == "migrate_in":
+                name, epoch = parts[1], int(parts[2])
+                blob = bytes.fromhex(parts[3])
+                with m.lock:
+                    row = m.rows.free_in_range(0, m.G)
+                    ok = (row is not None
+                          and coord.create_replica_group_at(
+                              name, epoch, blob, active_ids, row))
+                if ok:
+                    overrides.pop(name, None)  # we ARE the owner now
+                    emit(f"migrated_in {name} {epoch}")
+                else:
+                    emit(f"migrate_err {name} no_row")
+            elif cmd == "migrate_drop":
+                coord.drop_final_state(parts[1], int(parts[2]))
+                emit(f"migrate_dropped {parts[1]}")
+            elif cmd == "exit":
+                break
+            else:
+                emit(f"err unknown_cmd {cmd}")
+        except Exception as e:
+            emit(f"err {cmd} {type(e).__name__}: {e}")
+
+    fd.close()
+    if edge_m is not None:
+        edge_m.close()
+    cluster.shutdown(float(spec.get("drain_timeout_s", 10.0)))
+
+
+if __name__ == "__main__":
+    main()

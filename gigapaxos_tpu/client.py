@@ -84,6 +84,16 @@ class ReconfigurableAppClient:
         self._cb_ttl_s = 120.0
         #: name -> (expiry_monotonic, actives list)
         self._actives: Dict[str, Tuple[float, List[str]]] = {}
+        #: name -> (placement-table epoch at fill time, chosen target): the
+        #: per-name route memo.  Entries die on a table epoch bump (a
+        #: placement/cell override changed somewhere) or an explicit
+        #: _drop_route (the target failed / redirected this client).
+        self._route_cache: Dict[str, Tuple[int, str]] = {}
+        self._route_cache_cap = 4096
+        #: name -> current re-resolution backoff (full-jitter exponential,
+        #: the _rpc_rc scheme applied per name): a moved/bouncing name must
+        #: not hammer the RC with synchronized re-resolves
+        self._route_backoff: Dict[str, float] = {}
         self._rtt: Dict[str, float] = {}  # active id -> EWMA seconds
         self._sent_at: Dict[int, Tuple[str, float]] = {}
         for t in (pkt.CREATE_RESPONSE, pkt.CREATE_BATCH_RESPONSE,
@@ -154,13 +164,30 @@ class ReconfigurableAppClient:
                 self._cv.wait(timeout=left)
             return self._results.pop(rid)
 
+    def _rc_cycle_for(self, name: Optional[str]):
+        """The RC rotation for one name: with a cell-aware router attached
+        (cells.CellRouter duck-types ``rc_ids``), only the owner cell's
+        reconfigurators hold the name's records — rotating through foreign
+        cells' RCs would answer unknown_name.  Plain tables / no table:
+        the shared round-robin."""
+        t = self.placement_table
+        if name is not None and t is not None:
+            fn = getattr(t, "rc_ids", None)
+            if fn is not None:
+                ids = [r for r in fn(name)
+                       if r in self.rc_ids or self.nodemap(r) is not None]
+                if ids:
+                    return itertools.cycle(ids)
+        return self._rc_rr
+
     def _rpc_rc(self, packet: dict, timeout: float, tries: int = 3,
-                on_reply=None) -> dict:
+                on_reply=None, name: Optional[str] = None) -> dict:
         """Send a control request to reconfigurators, rotating on timeout.
 
         ``on_reply(resp, retried)`` may map the response before it is
         returned; ``retried`` is True when an earlier attempt timed out
-        (it may have committed server-side).
+        (it may have committed server-side).  ``name``: scope the rotation
+        to the name's owner-cell RCs when a cell router is attached.
 
         Retries back off exponentially with full random jitter (the AWS
         "full jitter" scheme): a failed-over RC otherwise gets every
@@ -172,12 +199,13 @@ class ReconfigurableAppClient:
         per = max(timeout / tries, 0.5)
         retried = False
         backoff = 0.1
+        rr = self._rc_cycle_for(name)
         for attempt in range(tries):
             if attempt > 0:
                 # full jitter: uniform in (0, backoff]; doubles per retry
                 time.sleep(random.uniform(0.0, backoff))
                 backoff = min(backoff * 2, 2.0)
-            rc = next(self._rc_rr)
+            rc = next(rr)
             p = dict(packet)
             p["rid"] = self._rid()
             try:
@@ -218,7 +246,7 @@ class ReconfigurableAppClient:
 
         return self._rpc_rc(
             pkt.create_service_name(name, initial_state, 0), timeout,
-            on_reply=on_reply,
+            on_reply=on_reply, name=name,
         )
 
     def create_batch(self, items, timeout: float = 30.0) -> dict:
@@ -262,16 +290,20 @@ class ReconfigurableAppClient:
                 "results": results}
 
     def delete(self, name: str, timeout: float = 15.0) -> dict:
-        resp = self._rpc_rc(pkt.delete_service_name(name, 0), timeout)
+        resp = self._rpc_rc(pkt.delete_service_name(name, 0), timeout,
+                            name=name)
         with self._lock:
             self._actives.pop(name, None)
+            self._route_cache.pop(name, None)
         return resp
 
     def reconfigure(self, name: str, new_actives: List[str],
                     timeout: float = 20.0) -> dict:
-        resp = self._rpc_rc(pkt.client_reconfigure(name, new_actives, 0), timeout)
+        resp = self._rpc_rc(pkt.client_reconfigure(name, new_actives, 0),
+                            timeout, name=name)
         with self._lock:
             self._actives.pop(name, None)
+            self._route_cache.pop(name, None)
         return resp
 
     # ------------------------------------------------------ node elasticity
@@ -317,8 +349,30 @@ class ReconfigurableAppClient:
             hit = self._actives.get(name)
             if hit is not None and not force and hit[0] > time.monotonic():
                 return list(hit[1])
-        resp = self._rpc_rc(pkt.request_active_replicas(name, 0), timeout)
+        # cell router fast path: static hash placement + the override map
+        # IS the directory, so the owner cell's actives come back with zero
+        # RC round-trips (and a migrated name resolves even though the
+        # destination cell's RC never heard of it).  force falls through —
+        # a failing name deserves the authoritative RC answer.
+        t = self.placement_table
+        if t is not None and not force and name != pkt.ALL_ACTIVES:
+            fn = getattr(t, "actives_of", None)
+            if fn is not None:
+                acts = fn(name)
+                if acts:
+                    return list(acts)
+        resp = self._rpc_rc(pkt.request_active_replicas(name, 0), timeout,
+                            name=name)
         if not resp.get("ok"):
+            # a migrated name is unknown to its destination cell's RC (the
+            # move rode the epoch machinery, not an RC create) — the router
+            # override is the directory of record, so answer from it
+            if t is not None and name != pkt.ALL_ACTIVES:
+                fn = getattr(t, "actives_of", None)
+                if fn is not None:
+                    acts = fn(name)
+                    if acts:
+                        return list(acts)
             raise ClientError(resp.get("error", "unknown_name"))
         actives = resp["actives"]
         for a, addr in resp.get("addrs", {}).items():
@@ -342,14 +396,59 @@ class ReconfigurableAppClient:
         newer truth than the RC answer, so a migrated group's requests reach
         the new shard without an RC round-trip.  Names without an override
         (and overrides whose server has already failed this request) fall
-        through to the RTT redirector over the RC's actives."""
+        through to the RTT redirector over the RC's actives.
+
+        The pick is memoized per name, keyed by the table's version epoch:
+        a placement/cell override committed anywhere bumps the epoch and
+        every cached route re-resolves on next use (stale routes otherwise
+        chase a migrated group through a full error round-trip first).  A
+        target that failed this request (``avoid``) bypasses and drops the
+        memo — the redirect path."""
         t = self.placement_table
+        epoch = getattr(t, "epoch", None) if t is not None else None
+        if epoch is not None:
+            with self._lock:
+                hit = self._route_cache.get(name)
+                if hit is not None:
+                    if (not avoid and hit[0] == epoch
+                            and (hit[1] in actives
+                                 or self.nodemap(hit[1]) is not None)):
+                        return hit[1]
+                    del self._route_cache[name]  # epoch bump / failed target
+        target = None
         if t is not None:
             lead = t.lead_server(name)
             if (lead is not None and lead not in avoid
                     and (lead in actives or self.nodemap(lead) is not None)):
-                return lead
-        return self._pick_active(actives, avoid)
+                target = lead
+        if target is None:
+            target = self._pick_active(actives, avoid)
+        if epoch is not None and not avoid:
+            with self._lock:
+                self._route_cache[name] = (epoch, target)
+                while len(self._route_cache) > self._route_cache_cap:
+                    self._route_cache.pop(next(iter(self._route_cache)))
+        return target
+
+    def _drop_route(self, name: str) -> None:
+        """Invalidate the name's memoized route + actives cache (cell-moved
+        redirect, failed target): the next request re-resolves."""
+        with self._lock:
+            self._route_cache.pop(name, None)
+            self._actives.pop(name, None)
+
+    def _resolve_backoff_sleep(self, name: str) -> None:
+        """Per-name full-jitter exponential backoff between re-resolution
+        attempts (the _rpc_rc scheme, keyed by name): every client chasing
+        one migrated group must not re-resolve in lockstep."""
+        with self._lock:
+            bo = self._route_backoff.get(name, 0.05)
+            self._route_backoff[name] = min(bo * 2, 2.0)
+        time.sleep(random.uniform(0.0, bo))
+
+    def _resolve_backoff_reset(self, name: str) -> None:
+        with self._lock:
+            self._route_backoff.pop(name, None)
 
     def _pick_active(self, actives: List[str], avoid=()) -> str:
         """Lowest-EWMA-RTT active, with epsilon exploration so a recovered
@@ -518,14 +617,22 @@ class ReconfigurableAppClient:
                     last = f"timeout via {target}"
                     self._penalize(target, per)
                     bad.add(target)
+                    self._drop_route(name)
+                    self._resolve_backoff_sleep(name)
                     continue
                 if resp.get("ok"):
+                    self._resolve_backoff_reset(name)
                     return pkt.b64d(resp["response"]) or b""
                 last = resp.get("error", "error")
-                if last not in ("not_active", "stopped", "busy"):
+                if last not in ("not_active", "stopped", "busy",
+                                "wrong_cell"):
                     raise ClientError(f"{name}: {last}")
+                # the target disowned the name (epoch change, cell move):
+                # drop the memoized route and re-resolve under per-name
+                # exponential backoff instead of a fixed lockstep sleep
                 bad.add(target)
-                time.sleep(min(0.1 * (attempt + 1), 0.5))
+                self._drop_route(name)
+                self._resolve_backoff_sleep(name)
             raise TimeoutError(f"{name}: {last}")
         finally:
             # a late response from an earlier attempt's target leaves the

@@ -232,6 +232,50 @@ class PlacementConfig:
 
 
 @dataclass
+class CellsConfig:
+    """Serving-cell host plane (cells/): N crash-isolated Mode A manager
+    processes per host, each owning ``crc32(name) % n_cells`` of the group
+    space with its own tick driver, WAL directory and transport endpoint,
+    under a :class:`cells.CellSupervisor`.
+
+    Properties keys: ``cells.n_cells=4``, ``cells.pin_cores=true``, ... —
+    see README "Serving cells" for sizing guidance (one cell per physical
+    core, minus one for the supervisor/edge).
+    """
+
+    # Master switch for server.py --cells bootstrap (the library API takes
+    # explicit constructor args and ignores this).
+    enabled: bool = False
+    # Cells per host.  0 = auto: max(1, os.cpu_count() - 1).
+    n_cells: int = 0
+    # Per-cell topology (each cell is a full InProcessCluster).
+    n_actives: int = 3
+    n_reconfigurators: int = 1
+    # Pin each cell worker to one core via sched_setaffinity (cell k ->
+    # core k % cpu_count).  Ignored on platforms without affinity support.
+    pin_cores: bool = True
+    # SO_REUSEPORT shared edge port (0 = no edge): every cell binds the same
+    # port and forwards mis-routed first requests to the owner cell, so a
+    # client with no placement table still reaches any group through one
+    # well-known address.
+    edge_port: int = 0
+    # Supervisor heartbeats (EWMA FailureDetection over the control socket).
+    heartbeat_interval_s: float = 0.25
+    heartbeat_timeout_s: float = 3.0
+    # Crash restart policy: exponential backoff base and per-cell cap.
+    restart_backoff_s: float = 0.5
+    max_restarts: int = 8
+    # Graceful SIGTERM drain budget before the supervisor escalates.
+    drain_timeout_s: float = 10.0
+
+    def __post_init__(self) -> None:
+        if self.n_cells < 0:
+            raise ValueError(f"cells.n_cells must be >= 0, got {self.n_cells}")
+        if self.n_actives < 1 or self.n_reconfigurators < 1:
+            raise ValueError("cells need >= 1 active and >= 1 reconfigurator")
+
+
+@dataclass
 class FailureDetectionConfig:
     """FailureDetection.java:63-76 analog (host-level, per node pair)."""
 
@@ -305,6 +349,7 @@ class GigapaxosTpuConfig:
     placement: PlacementConfig = field(default_factory=PlacementConfig)
     fd: FailureDetectionConfig = field(default_factory=FailureDetectionConfig)
     ssl: SSLConfig = field(default_factory=SSLConfig)
+    cells: CellsConfig = field(default_factory=CellsConfig)
     nodes: NodeConfig = field(default_factory=NodeConfig)
     # WAL directory; None = in-memory only (tests).
     log_dir: str | None = None
@@ -374,7 +419,7 @@ def load_properties(path: str) -> GigapaxosTpuConfig:
 
 def apply_env_overrides(cfg: GigapaxosTpuConfig) -> None:
     """Apply ``GPTPU_<SECTION>_<FIELD>`` environment overrides and re-validate."""
-    for sub_name in ("paxos", "placement", "fd", "ssl"):
+    for sub_name in ("paxos", "placement", "fd", "ssl", "cells"):
         sub = getattr(cfg, sub_name)
         for f_ in dataclasses.fields(sub):
             env = os.environ.get(f"GPTPU_{sub_name.upper()}_{f_.name.upper()}")
@@ -385,7 +430,7 @@ def apply_env_overrides(cfg: GigapaxosTpuConfig) -> None:
 
 def validate(cfg: GigapaxosTpuConfig) -> None:
     """Re-run dataclass validation (setattr bypasses ``__post_init__``)."""
-    for sub_name in ("paxos", "placement", "fd", "ssl"):
+    for sub_name in ("paxos", "placement", "fd", "ssl", "cells"):
         sub = getattr(cfg, sub_name)
         post = getattr(sub, "__post_init__", None)
         if post is not None:

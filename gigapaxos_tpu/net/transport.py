@@ -312,6 +312,7 @@ class Transport:
         security: Optional[TransportSecurity] = None,
         coalesce_frames: int = _IOV_MAX // 2,
         coalesce_bytes: int = 8 * 1024 * 1024,
+        reuse_port: bool = False,
     ):
         self.node_id = node_id
         self.demux = demux
@@ -338,7 +339,9 @@ class Transport:
         self.stats: Dict[str, int] = {}
         self._slock = threading.Lock()
 
-        self._server = socket.create_server(bind, reuse_port=False)
+        # reuse_port=True: every serving cell of a host binds the same edge
+        # port and the kernel load-balances accepts across them (cells/)
+        self._server = socket.create_server(bind, reuse_port=reuse_port)
         self._server.settimeout(0.25)
         self.port = self._server.getsockname()[1]
         self._acceptor = threading.Thread(

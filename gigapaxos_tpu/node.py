@@ -25,6 +25,8 @@ objects are unchanged — only the manager's mesh placement differs.
 
 from __future__ import annotations
 
+import os
+import signal
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -146,6 +148,8 @@ class InProcessCluster:
         coordinator: str = "paxos",
         spare_replica_slots: int = 0,
         spare_rc_slots: int = 0,
+        wal_dir: Optional[str] = None,
+        rc_wal_dir: Optional[str] = None,
     ):
         self.cfg = cfg
         active_ids = cfg.nodes.active_ids()
@@ -168,9 +172,19 @@ class InProcessCluster:
             self.manager = ChainManager(cfg, n_slots, apps, wal=wal)
             self.coordinator = ChainReplicaCoordinator(self.manager, active_ids)
         elif coordinator == "paxos":
-            self.manager = PaxosManager(cfg, n_slots, apps, wal=wal,
-                                        spill_ns="ar")
+            if wal_dir is not None:
+                if wal is not None:
+                    raise ValueError("pass wal= or wal_dir=, not both")
+                self.manager = self._open_plane(cfg, n_slots, apps,
+                                                wal_dir, "ar")
+            else:
+                self.manager = PaxosManager(cfg, n_slots, apps, wal=wal,
+                                            spill_ns="ar")
             self.coordinator = PaxosReplicaCoordinator(self.manager, active_ids)
+            # a WAL-replayed manager has its groups back but the fresh
+            # coordinator's epoch map is empty — re-adopt name#epoch rows so
+            # recovered groups answer instead of "not_active"
+            self.coordinator.adopt_live_epochs()
         else:
             raise ValueError(f"unknown coordinator {coordinator!r}")
         self.driver = TickDriver(self.manager).start()
@@ -190,8 +204,14 @@ class InProcessCluster:
 
             rc_cfg = _copy.copy(cfg)
             rc_cfg.paxos = _dc.replace(cfg.paxos, device_app=False)
-        self.rc_manager = PaxosManager(rc_cfg, len(rc_apps), rc_apps,
-                                       wal=rc_wal, spill_ns="rc")
+        if rc_wal_dir is not None:
+            if rc_wal is not None:
+                raise ValueError("pass rc_wal= or rc_wal_dir=, not both")
+            self.rc_manager = self._open_plane(rc_cfg, len(rc_apps), rc_apps,
+                                               rc_wal_dir, "rc")
+        else:
+            self.rc_manager = PaxosManager(rc_cfg, len(rc_apps), rc_apps,
+                                           wal=rc_wal, spill_ns="rc")
         self.rdb = RepliconfigurableReconfiguratorDB(
             self.rc_manager, rc_ids, k=rc_group_size
         )
@@ -247,6 +267,25 @@ class InProcessCluster:
                     adaptive_gain=cfg.fd.adaptive_gain,
                     on_change=self._fd_change,
                 )
+
+    @staticmethod
+    def _open_plane(cfg, n_slots: int, apps, wal_dir: str, ns: str):
+        """Build one plane's manager against an on-disk WAL directory:
+        recover (snapshot + journal replay) when the directory already holds
+        a journal, else start fresh with a new logger — the cell worker's
+        crash-restart path (cells/worker.py) in one switch."""
+        from .wal import logger as wal_logger
+
+        os.makedirs(wal_dir, exist_ok=True)
+        if any(fn.startswith(("journal.", "snapshot."))
+               for fn in os.listdir(wal_dir)):
+            return wal_logger.recover(cfg, n_slots, apps, wal_dir,
+                                      native=cfg.native_journal, spill_ns=ns)
+        wal = wal_logger.PaxosLogger(
+            wal_dir, sync_every_ticks=cfg.paxos.sync_every_ticks,
+            native=cfg.native_journal,
+        )
+        return PaxosManager(cfg, n_slots, apps, wal=wal, spill_ns=ns)
 
     def _fd_change(self, node: str, up: bool) -> None:
         self._liveness[node] = up
@@ -353,16 +392,73 @@ class InProcessCluster:
         TESTPaxosConfig.crash, testing/TESTPaxosConfig.java:563-578)."""
         self._liveness[node] = up
 
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        """Quiesce both planes: kick the drivers until no proposal is
+        outstanding and every journaled tick is fsync-covered (a response
+        the client saw must never be lost by the shutdown that follows).
+        Returns False if the deadline passed with work still in flight."""
+        deadline = time.monotonic() + timeout_s
+        planes = [(self.driver, self.manager), (self.rc_driver, self.rc_manager)]
+        while True:
+            busy = False
+            for drv, m in planes:
+                wal = getattr(m, "wal", None)
+                if m.pending_count() > 0 or (wal is not None
+                                             and not wal.is_synced()):
+                    busy = True
+                    drv.kick()
+            if not busy:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.005)
+
     def close(self) -> None:
         self.stop_rebalancer()
         for fd in self.fds.values():
             fd.close()
+        # drivers stop BEFORE the messengers close: a tick flushing frames
+        # after its transport died would fail sends mid-commit (the old
+        # order); stop() also drains the execution pipeline
+        self.driver.stop()
+        self.rc_driver.stop()
+        # final fsync + journal close: an acked commit must be disk-covered
+        # before the process exits
+        for m in (self.manager, self.rc_manager):
+            wal = getattr(m, "wal", None)
+            if wal is not None:
+                try:
+                    if wal.journal is not None:
+                        wal.journal.sync()
+                    wal.close()
+                except Exception:
+                    pass
         for ar in self.actives.values():
             ar.close()
         for rc in self.reconfigurators.values():
             rc.close()
-        self.driver.stop()
-        self.rc_driver.stop()
+
+    def shutdown(self, drain_timeout_s: float = 10.0) -> bool:
+        """Graceful stop: drain in-flight work, then close.  Returns the
+        drain verdict (close happens either way)."""
+        ok = self.drain(drain_timeout_s)
+        self.close()
+        return ok
+
+    def install_sigterm(self, drain_timeout_s: float = 10.0,
+                        on_exit: Optional[Callable[[], None]] = None) -> None:
+        """SIGTERM = graceful cell shutdown (cells/worker.py, systemd stop):
+        drain the in-flight tick, flush + close the WAL, close transports,
+        then exit 0.  Main-thread only (signal module constraint)."""
+        def _handler(signum, frame):
+            try:
+                self.shutdown(drain_timeout_s)
+                if on_exit is not None:
+                    on_exit()
+            finally:
+                os._exit(0)
+
+        signal.signal(signal.SIGTERM, _handler)
 
 
 def build_node(

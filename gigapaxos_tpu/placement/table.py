@@ -24,6 +24,25 @@ from ..reconfiguration.consistent_hashing import ConsistentHashRing
 #: replicated on every reconfigurator like the NC records)
 PLACEMENT_RECORD = "_PLACEMENT"
 
+#: key prefix distinguishing cell overrides from shard overrides inside the
+#: same ``rc_epochs`` map ("c:<service>" -> packed (host shard, cell))
+CELL_KEY_PREFIX = "c:"
+#: packing stride for (host shard, cell) into one int: value =
+#: shard * stride + cell — 256 cells per host is far above any core count
+CELL_STRIDE = 256
+
+
+def pack_host_cell(shard: int, cell: int) -> int:
+    """Encode a (host shard, serving cell) pair into one rc_epochs int."""
+    if not (0 <= cell < CELL_STRIDE):
+        raise ValueError(f"cell {cell} out of range [0, {CELL_STRIDE})")
+    return int(shard) * CELL_STRIDE + int(cell)
+
+
+def unpack_host_cell(packed: int) -> tuple:
+    """Inverse of :func:`pack_host_cell` -> (shard, cell)."""
+    return int(packed) // CELL_STRIDE, int(packed) % CELL_STRIDE
+
 
 class PlacementTable:
     """name -> destination shard overrides, layered over a hash ring.
@@ -45,13 +64,36 @@ class PlacementTable:
         }
         self._server_of_shard = {v: k for k, v in self.shard_of_server.items()}
         self.overrides: Dict[str, int] = {}
+        #: name -> (host shard, serving cell) for names whose group was
+        #: migrated across cells (cells/migrator.py); absent = static
+        #: ``cell_of`` hash placement
+        self.cell_overrides: Dict[str, tuple] = {}
+        #: version counter, bumped on every override change and adopted from
+        #: the ``_PLACEMENT`` record's epoch — clients key their route-cache
+        #: invalidation off it (client._route)
+        self.epoch = 0
 
     # ------------------------------------------------------------- overrides
     def set_override(self, name: str, shard: int) -> None:
         self.overrides[name] = int(shard)
+        self.epoch += 1
 
     def clear_override(self, name: str) -> None:
-        self.overrides.pop(name, None)
+        if self.overrides.pop(name, None) is not None:
+            self.epoch += 1
+
+    def set_cell_override(self, name: str, shard: int, cell: int) -> None:
+        self.cell_overrides[name] = (int(shard), int(cell))
+        self.epoch += 1
+
+    def clear_cell_override(self, name: str) -> None:
+        if self.cell_overrides.pop(name, None) is not None:
+            self.epoch += 1
+
+    def cell_of_name(self, name: str) -> Optional[tuple]:
+        """The (host shard, cell) a migrated name now lives in, or None for
+        default hash placement."""
+        return self.cell_overrides.get(name)
 
     def default_shard(self, name: str) -> int:
         primary = self.ring.primary(name)
@@ -106,14 +148,34 @@ class PlacementTable:
         return {"op": "placement_set", "name": PLACEMENT_RECORD,
                 "service": name, "shard": ov}
 
+    def to_cell_command(self, name: str) -> dict:
+        """The committed command installing ``name``'s current cell override
+        (``placement_clear_cell`` when none)."""
+        ov = self.cell_overrides.get(name)
+        if ov is None:
+            return {"op": "placement_clear_cell", "name": PLACEMENT_RECORD,
+                    "service": name}
+        return {"op": "placement_set_cell", "name": PLACEMENT_RECORD,
+                "service": name, "shard": ov[0], "cell": ov[1]}
+
     def load_record(self, record_dict: Optional[dict]) -> None:
-        """Adopt the override map from a ``_PLACEMENT`` record dict (as
+        """Adopt the override maps from a ``_PLACEMENT`` record dict (as
         produced by ``ReconfigurationRecord.to_dict`` after rc_db applied
-        placement commands); None/missing clears."""
-        self.overrides = {
-            str(n): int(s)
-            for n, s in (record_dict or {}).get("rc_epochs", {}).items()
-        }
+        placement commands); None/missing clears.  Cell overrides live in
+        the same rc_epochs map under ``c:``-prefixed keys; the record's
+        epoch becomes the table's version counter so client route caches
+        invalidate on adoption."""
+        self.overrides = {}
+        self.cell_overrides = {}
+        rec = record_dict or {}
+        for n, s in rec.get("rc_epochs", {}).items():
+            n = str(n)
+            if n.startswith(CELL_KEY_PREFIX):
+                self.cell_overrides[n[len(CELL_KEY_PREFIX):]] = \
+                    unpack_host_cell(int(s))
+            else:
+                self.overrides[n] = int(s)
+        self.epoch = int(rec.get("epoch", self.epoch + 1))
 
     def splice(self, ring: ConsistentHashRing,
                shard_of_server: Optional[Dict[str, int]] = None) -> None:
@@ -142,9 +204,18 @@ def apply_placement_command(records: dict, cmd: dict, make_record) -> dict:
     service = cmd.get("service", "")
     if not service:
         return {"ok": False, "error": "no_service"}
-    if cmd["op"] == "placement_set":
+    op = cmd["op"]
+    if op == "placement_set":
         rec.rc_epochs[service] = int(cmd["shard"])
-    else:
+    elif op == "placement_clear":
         rec.rc_epochs.pop(service, None)
+    elif op == "placement_set_cell":
+        rec.rc_epochs[CELL_KEY_PREFIX + service] = pack_host_cell(
+            int(cmd.get("shard", 0)), int(cmd["cell"])
+        )
+    elif op == "placement_clear_cell":
+        rec.rc_epochs.pop(CELL_KEY_PREFIX + service, None)
+    else:
+        return {"ok": False, "error": "bad_op"}
     rec.epoch += 1  # version counter, mirrors the NC records
     return {"ok": True, "overrides": dict(rec.rc_epochs), "epoch": rec.epoch}

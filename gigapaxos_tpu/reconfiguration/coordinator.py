@@ -128,6 +128,38 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
     def current_epoch(self, name: str) -> Optional[int]:
         return self._epoch.get(name)
 
+    def adopt_live_epochs(self) -> int:
+        """Rebuild the name -> live-epoch map from a WAL-recovered manager.
+
+        ``wal.logger.recover`` reproduces the manager's rows and paused set,
+        but a coordinator constructed over it starts with an empty epoch map
+        and would answer "not_active" for every recovered group.  Scan live
+        + paused paxos names for ``name#epoch``, skip stopped epochs (their
+        final state stays fetchable, they are not live), and adopt the max
+        epoch per name.  Idempotent; a no-op on a fresh manager.  Returns
+        how many names were adopted."""
+        m = self.manager
+        with m.lock:
+            pnames = list(m.rows.names()) + [
+                n for n in m._paused if n not in m.rows
+            ]
+            adopted = 0
+            for pname in pnames:
+                base, sep, etxt = pname.rpartition("#")
+                if not sep or not base:
+                    continue
+                try:
+                    epoch = int(etxt)
+                except ValueError:
+                    continue
+                if m.is_stopped(pname):
+                    continue
+                cur = self._epoch.get(base)
+                if cur is None or epoch > cur:
+                    self._epoch[base] = epoch
+                    adopted += 1
+        return adopted
+
     # ------------------------------------------------------------------- SPI
     def coordinate_request(
         self,

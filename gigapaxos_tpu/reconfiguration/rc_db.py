@@ -203,7 +203,8 @@ class ReconfiguratorDB(Replicable):
             rec.epoch += 1  # NC epoch counts config versions
             return {"ok": True, "pool": rec.actives, "epoch": rec.epoch,
                     "universe": list(rec.universe)}
-        if op in ("placement_set", "placement_clear"):
+        if op in ("placement_set", "placement_clear",
+                  "placement_set_cell", "placement_clear_cell"):
             # placement-override table (placement/table.py): overrides ride
             # the special _PLACEMENT record's rc_epochs map, so they are
             # replicated/checkpointed like every other record.  Import is

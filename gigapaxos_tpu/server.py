@@ -321,19 +321,55 @@ class ModeBServer:
                 n.close()
 
 
+def _run_cells(cfg: GigapaxosTpuConfig, log_dir: Optional[str]) -> None:
+    """``--cells`` bootstrap: one supervised multi-core host plane instead
+    of one ModeBServer process — N crash-isolated Mode A cells (cells/),
+    sized and tuned by the ``cells.*`` properties section."""
+    from .cells.supervisor import build_supervisor
+
+    base_dir = log_dir or cfg.log_dir or os.path.join(
+        os.getcwd(), "gptpu-cells")
+    os.makedirs(base_dir, exist_ok=True)
+    sup = build_supervisor(cfg, base_dir, edge=cfg.cells.edge_port > 0)
+    sup.start()
+    edge = (f" edge={sup.edge_addr[0]}:{sup.edge_addr[1]}"
+            if sup.edge_addr else "")
+    print(f"gigapaxos_tpu cells host ready: {sup.n_cells} cells{edge}",
+          flush=True)
+
+    stop = threading.Event()
+
+    def _sig(_signum, _frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    stop.wait()
+    sup.stop()
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(
         description="gigapaxos_tpu per-process server (gpServer.sh analog)"
     )
-    ap.add_argument("--node", required=True, help="node id from the topology")
+    ap.add_argument("--node", default=None, help="node id from the topology")
     ap.add_argument("--properties", required=True,
                     help="gigapaxos.properties-style topology/config file")
     ap.add_argument("--log-dir", default=None, help="WAL root directory")
     ap.add_argument("--no-fd", action="store_true",
                     help="disable the failure detector (tests only)")
+    ap.add_argument("--cells", action="store_true",
+                    help="boot the multi-core serving-cell plane (cells/) "
+                         "for this host instead of a single-node server; "
+                         "sized by the cells.* properties section")
     args = ap.parse_args(argv)
 
     cfg = load_properties(args.properties)
+    if args.cells or cfg.cells.enabled:
+        _run_cells(cfg, args.log_dir)
+        return
+    if not args.node:
+        ap.error("--node is required unless --cells is set")
     server = ModeBServer(
         args.node, cfg, log_dir=args.log_dir, start_fd=not args.no_fd
     )

@@ -27,3 +27,20 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running test (multi-process, soak)"
     )
+    config.addinivalue_line(
+        "markers",
+        "multicore: needs real parallel cores (cell scaling asserts); "
+        "auto-skipped when os.cpu_count() < 4",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    if (os.cpu_count() or 1) >= 4:
+        return
+    skip = pytest.mark.skip(
+        reason=f"multicore test needs >=4 cores, have {os.cpu_count()}")
+    for item in items:
+        if "multicore" in item.keywords:
+            item.add_marker(skip)

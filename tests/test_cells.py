@@ -1,0 +1,345 @@
+"""Serving-cell plane tests: routing units, a 2-cell end-to-end smoke, the
+SIGKILL crash-safety scenario (bit-identical WAL replay + S1 ledger), and
+the multi-core scaling gate.
+
+The per-process pieces mirror tests/test_modeb_multiprocess.py (real OS
+processes, SIGKILL via ``testing.chaos.ProcChaosRunner``); the routing
+units exercise cells/routing.py and the placement-table cell extensions
+with no processes at all.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from gigapaxos_tpu.cells.routing import CellRouter, cell_of
+from gigapaxos_tpu.config import CellsConfig
+from gigapaxos_tpu.placement.table import (
+    PLACEMENT_RECORD,
+    PlacementTable,
+    apply_placement_command,
+    pack_host_cell,
+    unpack_host_cell,
+)
+from gigapaxos_tpu.reconfiguration.consistent_hashing import ConsistentHashRing
+
+
+# --------------------------------------------------------------- routing units
+def test_cell_of_stable_and_in_range():
+    for n in (1, 2, 3, 8):
+        for name in ("g0", "svc-17", "a" * 64):
+            k = cell_of(name, n)
+            assert 0 <= k < n
+            assert k == cell_of(name, n)  # pure function of (name, n)
+    assert cell_of("anything", 1) == 0
+
+
+def test_cell_router_directory_and_overrides():
+    r = CellRouter([["c0.AR0", "c0.AR1"], ["c1.AR0", "c1.AR1"]],
+                   [["c0.RC0"], ["c1.RC0"]])
+    name = "grp"
+    home = cell_of(name, 2)
+    assert r.cell(name) == home
+    assert r.actives_of(name) == r.actives_by_cell[home]
+    assert r.rc_ids(name) == r.rcs_by_cell[home]
+    e0 = r.epoch
+    r.set_override(name, 1 - home)
+    assert r.cell(name) == 1 - home and r.epoch == e0 + 1
+    # owner-cell nodes lead in an arbitrary active list
+    mixed = ["c0.AR0", "c1.AR1", "c0.AR1", "c1.AR0"]
+    ordered = r.order_actives(name, mixed)
+    own = set(r.actives_by_cell[1 - home])
+    assert set(ordered[:2]) <= own and ordered == sorted(
+        mixed, key=lambda a: a not in own)
+    r.clear_override(name)
+    assert r.cell(name) == home
+    with pytest.raises(ValueError):
+        r.set_override(name, 5)
+
+
+def test_pack_unpack_host_cell_roundtrip():
+    for shard, cell in [(0, 0), (3, 7), (12, 255)]:
+        assert unpack_host_cell(pack_host_cell(shard, cell)) == (shard, cell)
+    with pytest.raises(ValueError):
+        pack_host_cell(0, 256)
+
+
+def test_placement_table_cell_override_commands_roundtrip():
+    """Cell overrides ride the replicated _PLACEMENT record exactly like
+    shard overrides: apply the committed command, re-derive the table from
+    the record dict, and the override (plus the epoch bump the client
+    route-cache keys on) comes back."""
+    from gigapaxos_tpu.reconfiguration.records import ReconfigurationRecord
+
+    ring = ConsistentHashRing(["s0", "s1"])
+    t = PlacementTable(ring)
+    t.set_cell_override("g", 1, 3)
+    records = {}
+    make = lambda n: ReconfigurationRecord(name=n)  # noqa: E731
+    r1 = apply_placement_command(records, t.to_cell_command("g"), make)
+    assert r1["ok"]
+    r2 = apply_placement_command(
+        records, {"op": "placement_set", "name": PLACEMENT_RECORD,
+                  "service": "h", "shard": 1}, make)
+    assert r2["ok"]
+    rec = records[PLACEMENT_RECORD]
+    t2 = PlacementTable(ring)
+    e0 = t2.epoch
+    t2.load_record({"rc_epochs": dict(rec.rc_epochs), "epoch": rec.epoch})
+    assert t2.cell_of_name("g") == (1, 3)
+    assert t2.overrides == {"h": 1}
+    assert t2.epoch == rec.epoch and t2.epoch != e0
+    # clear round-trips too
+    assert apply_placement_command(
+        records, {"op": "placement_clear_cell", "name": PLACEMENT_RECORD,
+                  "service": "g"}, make)["ok"]
+    t3 = PlacementTable(ring)
+    t3.load_record({"rc_epochs": dict(rec.rc_epochs), "epoch": rec.epoch})
+    assert t3.cell_of_name("g") is None
+
+
+def test_router_adopts_placement_table_cell_overrides():
+    ring = ConsistentHashRing(["s0"])
+    t = PlacementTable(ring)
+    t.set_cell_override("g", 0, 1)
+    r = CellRouter([["c0.AR0"], ["c1.AR0"]], [["c0.RC0"], ["c1.RC0"]])
+    r.load_table(t)
+    assert r.cell("g") == 1 and r.epoch == t.epoch
+
+
+def test_client_route_cache_invalidates_on_epoch_bump():
+    """Satellite: the client's memoized route dies when the router's epoch
+    bumps (a cell override landed) and re-resolves to the new owner."""
+    from gigapaxos_tpu.client import ReconfigurableAppClient
+    from gigapaxos_tpu.config import NodeConfig
+
+    nodes = NodeConfig()
+    nodes.actives = {"c0.AR0": ("127.0.0.1", 1), "c1.AR0": ("127.0.0.1", 2)}
+    nodes.reconfigurators = {"c0.RC0": ("127.0.0.1", 3)}
+    router = CellRouter([["c0.AR0"], ["c1.AR0"]], [["c0.RC0"], ["c0.RC0"]])
+    c = ReconfigurableAppClient(nodes, placement_table=router)
+    try:
+        name = "grp"
+        home = router.cell(name)
+        t1 = c._route(name, router.actives_of(name))
+        assert t1 == f"c{home}.AR0"
+        assert c._route_cache[name] == (router.epoch, t1)
+        router.set_override(name, 1 - home)  # epoch bump
+        t2 = c._route(name, router.actives_of(name))
+        assert t2 == f"c{1 - home}.AR0"
+        assert c._route_cache[name] == (router.epoch, t2)
+        # explicit drop (cell-moved redirect path) empties both caches
+        c._actives[name] = (time.monotonic() + 30, ["c0.AR0"])
+        c._drop_route(name)
+        assert name not in c._route_cache and name not in c._actives
+        # per-name backoff doubles then resets
+        c._resolve_backoff_sleep(name)
+        c._resolve_backoff_sleep(name)
+        assert c._route_backoff[name] == pytest.approx(0.2)
+        c._resolve_backoff_reset(name)
+        assert name not in c._route_backoff
+    finally:
+        c.close()
+
+
+# ------------------------------------------------------------ process harness
+def _mk_supervisor(base_dir, n_cells=2, **kw):
+    from gigapaxos_tpu.cells.supervisor import CellSupervisor
+
+    cc = CellsConfig(enabled=True, n_cells=n_cells, n_actives=3,
+                     n_reconfigurators=1, pin_cores=kw.pop("pin_cores", False),
+                     restart_backoff_s=0.2)
+    kw.setdefault("paxos_overrides", {"max_groups": 16})
+    return CellSupervisor(str(base_dir), cells=cc, **kw)
+
+
+def _drain_all(sup):
+    for h in sup.cells.values():
+        assert h.rpc("drain", "drained ", 60).endswith("ok")
+
+
+def _dbs(sup):
+    return {k: h.db() for k, h in sorted(sup.cells.items())}
+
+
+def test_two_cell_smoke(tmp_path):
+    """Tier-1 fast-suite smoke: 2 cells up, groups land on their hash-owner
+    cell, requests route with zero extra hops, graceful stop drains."""
+    sup = _mk_supervisor(tmp_path / "cells").start()
+    try:
+        c = sup.make_client()
+        names = [f"s{i}" for i in range(4)]
+        for n in names:
+            assert c.create(n).get("ok"), n
+        for i, n in enumerate(names):
+            assert c.request(n, f"PUT k{i} v{i}".encode()) == b"OK"
+            assert c.request(n, f"GET k{i}".encode()) == f"v{i}".encode()
+        # groups really live on their owner cells (stats counts the RC
+        # group + the created names per cell)
+        per_cell = {k: sum(1 for n in names if cell_of(n, 2) == k)
+                    for k in (0, 1)}
+        assert sum(per_cell.values()) == len(names)
+        for k, h in sup.cells.items():
+            assert h.stats()["groups"] == per_cell[k]
+        c.close()
+    finally:
+        sup.stop()
+    # both cells exited via the graceful SIGTERM path
+    assert all(not h.alive() for h in sup.cells.values())
+
+
+@pytest.mark.slow
+def test_cell_sigkill_replay_bit_identical_and_s1(tmp_path):
+    """Crash-safety scenario (ISSUE satellite): SIGKILL one cell mid-
+    workload under ProcChaosRunner, the supervisor restarts it, WAL replay
+    makes its state bit-identical to a never-killed control run, and the
+    union of pre-kill and post-restart execution ledgers carries zero S1
+    violations (no (group, slot) ever decided two rids across the crash)."""
+    from gigapaxos_tpu.testing.chaos import (
+        ChaosEvent,
+        ChaosSchedule,
+        ProcChaosRunner,
+        SafetyLedger,
+    )
+
+    names = [f"g{i}" for i in range(4)]
+    phase1 = [(n, f"PUT p1k{i}.{n} a") for i, n in enumerate(names)]
+    phase2 = [(n, f"PUT p2k{i}.{n} b") for i, n in enumerate(names)]
+
+    def run(base, kill: bool):
+        sup = _mk_supervisor(base, ledger=True).start()
+        try:
+            c = sup.make_client()
+            for n in names:
+                assert c.create(n).get("ok"), n
+            for n, op in phase1:
+                assert c.request(n, op.encode()) == b"OK"
+            pre_ledger = []
+            if kill:
+                victim = sup.router.cell(names[0])
+                _drain_all(sup)
+                pre_ledger = sup.cells[victim].ledger()
+                sched = ChaosSchedule("cell-kill", [
+                    ChaosEvent(at_tick=0, action="crash",
+                               args={"node": f"c{victim}"}),
+                ])
+                ProcChaosRunner({f"c{victim}": sup.cells[victim]}, sched,
+                                tick_s=0.01).run()
+                assert not sup.cells[victim].alive()
+                sup.wait_cell_alive(victim, 600)
+                assert sup.restarts[victim] == 1
+            for n, op in phase2:
+                # the restarted cell may still be warming: the client's
+                # retry/backoff loop is exactly what's under test here
+                assert c.request(n, op.encode(), timeout=60) == b"OK"
+            _drain_all(sup)
+            dbs = _dbs(sup)
+            post_ledger = (sup.cells[sup.router.cell(names[0])].ledger()
+                           if kill else [])
+            c.close()
+            return dbs, pre_ledger, post_ledger
+        finally:
+            sup.stop()
+
+    chaos_dbs, pre_led, post_led = run(tmp_path / "chaos", kill=True)
+    control_dbs, _, _ = run(tmp_path / "control", kill=False)
+
+    # WAL replay bit-identity: every cell's app state matches the
+    # never-killed run exactly (same groups, same epochs, same KV content)
+    assert json.dumps(chaos_dbs, sort_keys=True) == \
+        json.dumps(control_dbs, sort_keys=True)
+
+    # S1 across the crash: pre-kill execution and post-restart replay (plus
+    # everything after) must agree on every (group, slot).  Cross-run rids
+    # differ by design, so the ledger union is within the chaos run only.
+    led = SafetyLedger()
+    for r, name, slot, rid, _stop in pre_led:
+        led.observe(f"pre/r{r}", name, slot, rid)
+    for r, name, slot, rid, _stop in post_led:
+        led.observe(f"post/r{r}", name, slot, rid)
+    assert led.observations >= len(pre_led) + len(post_led) > 0
+    led.assert_safe()
+    # (full pre-kill ledger COVERAGE by the replay is deliberately not
+    # asserted: a WAL snapshot between phase 1 and the kill legitimately
+    # compacts pre-snapshot decisions out of the journal — durability of
+    # every acked write is what the bit-identity check above proves)
+
+
+@pytest.mark.slow
+def test_cell_migration_moves_group_and_serving_continues(tmp_path):
+    from gigapaxos_tpu.cells.migrator import CellMigrator
+
+    sup = _mk_supervisor(tmp_path / "cells").start()
+    try:
+        c = sup.make_client()
+        assert c.create("m0").get("ok")
+        assert c.request("m0", b"PUT a 1") == b"OK"
+        src = sup.router.cell("m0")
+        dst = 1 - src
+        assert CellMigrator(sup).migrate("m0", dst)
+        assert sup.router.cell("m0") == dst
+        # the moved group serves reads AND writes from its new cell, and
+        # the destination worker really owns it now
+        assert c.request("m0", b"GET a") == b"1"
+        assert c.request("m0", b"PUT b 2") == b"OK"
+        assert any(k.startswith("m0#") for k in sup.cells[dst].db())
+        c.close()
+    finally:
+        sup.stop()
+
+
+@pytest.mark.slow
+def test_edge_forwards_misrouted_request_to_owner_cell(tmp_path):
+    """A client that only knows the shared SO_REUSEPORT edge address still
+    reaches any group: whichever cell accepts the connection forwards to
+    the owner, which answers the client directly (reply_to)."""
+    sup = _mk_supervisor(tmp_path / "cells", edge=True).start()
+    try:
+        c = sup.make_client()
+        assert c.create("e0").get("ok")
+        assert c.request("e0", b"PUT x 7") == b"OK"
+        ec = sup.make_client()
+        ec.nodemap.add("EDGE", sup.edge_addr[0], int(sup.edge_addr[1]))
+        done = threading.Event()
+        box = {}
+
+        def cb(p):
+            box.update(p)
+            done.set()
+
+        ec.send_request("e0", b"GET x", cb, active="EDGE")
+        assert done.wait(30), "edge request timed out"
+        assert box.get("ok"), box
+        from gigapaxos_tpu.reconfiguration import packets as pkt
+
+        assert pkt.b64d(box["response"]) == b"7"
+        ec.close()
+        c.close()
+    finally:
+        sup.stop()
+
+
+@pytest.mark.slow
+@pytest.mark.multicore
+def test_cells_scale_capacity_across_cores(tmp_path):
+    """Scaling gate (multi-core boxes only): 2 cells sustain meaningfully
+    more closed-loop throughput than 1 cell on the same box, and each
+    worker burns its own core (cores_busy attribution from /proc)."""
+    from benchmarks.cells_capacity import measure_cells
+
+    r1 = measure_cells(str(tmp_path / "c1"), n_cells=1, seconds=5.0)
+    r2 = measure_cells(str(tmp_path / "c2"), n_cells=2, seconds=5.0)
+    assert r2["reqs_per_s"] >= 1.3 * r1["reqs_per_s"], (r1, r2)
+    assert len(r2["cores_busy"]) == 2
+
+
+def test_cells_config_validation():
+    cc = CellsConfig()
+    assert not cc.enabled and cc.n_cells == 0
+    with pytest.raises(ValueError):
+        CellsConfig(n_cells=-1)
+    with pytest.raises(ValueError):
+        CellsConfig(n_actives=0)

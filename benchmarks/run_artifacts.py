@@ -289,6 +289,17 @@ def bench_chaos_replay() -> dict:
     }
 
 
+def bench_obs_overhead() -> dict:
+    """Flight-deck overhead gate (benchmarks/obs_overhead.py): refreshes
+    results_obs_pr9.json — decisions/s at the capacity knee and large-G
+    tick ms, metrics on vs GPTPU_METRICS=0, must stay under 2%."""
+    r = _script(["benchmarks/obs_overhead.py"], timeout=3600)[-1]
+    if not r["pass"]:
+        raise RuntimeError(
+            f"metrics overhead {r['value']}% >= {r['pass_lt_pct']}% gate")
+    return r
+
+
 def bench_cells_capacity() -> dict:
     """Serving-cells capacity sweep (benchmarks/cells_capacity.py):
     refreshes results_capacity_cells_pr8.json (1 -> 2 -> 4 cells with
@@ -366,6 +377,8 @@ def main() -> None:
     run("chaos_replay", bench_chaos_replay)
     # serving-cell plane (PR 8): multi-core host capacity sweep
     run("cells_capacity", bench_cells_capacity)
+    # flight-deck plane (PR 9): always-on metrics overhead gate
+    run("obs_overhead", bench_obs_overhead)
 
     out = args.out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),

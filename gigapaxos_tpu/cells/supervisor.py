@@ -41,6 +41,10 @@ from typing import Dict, List, Optional
 from ..config import CellsConfig, GigapaxosTpuConfig, NodeConfig
 from ..net.failure_detection import FailureDetection
 from ..net.messenger import Messenger, NodeMap
+from ..obs.http import MetricsServer
+from ..obs.metrics import NullRegistry, Registry, metrics_enabled
+from ..obs.prom import merge_scrapes, render_registry
+from ..utils import reqtrace
 from .routing import CellRouter
 
 SUP_ID = "SUP"
@@ -73,6 +77,8 @@ class CellSpec:
     ledger: bool = False
     overrides: Dict[str, int] = field(default_factory=dict)
     drain_timeout_s: float = 10.0
+    flight: Optional[str] = None
+    stats_interval_s: float = 2.0
 
     def to_json(self) -> str:
         return json.dumps({
@@ -85,6 +91,8 @@ class CellSpec:
             "paxos": self.paxos, "cfg": self.cfg,
             "ledger": self.ledger, "overrides": self.overrides,
             "drain_timeout_s": self.drain_timeout_s,
+            "flight": self.flight,
+            "stats_interval_s": self.stats_interval_s,
         })
 
 
@@ -147,6 +155,19 @@ class CellHandle:
     def stats(self, timeout: float = 30.0) -> dict:
         return json.loads(self.rpc("stats", "stats ", timeout)[6:])
 
+    def metrics(self, timeout: float = 30.0) -> str:
+        """This cell's Prometheus text body (every series cell-labelled)."""
+        return json.loads(self.rpc("metrics", "metrics ", timeout)[8:])
+
+    def trace(self, tid: Optional[str] = None, timeout: float = 30.0) -> dict:
+        cmd = "trace" if tid is None else f"trace {tid}"
+        return json.loads(self.rpc(cmd, "trace ", timeout)[6:])
+
+    @property
+    def flight_path(self) -> Optional[str]:
+        """On-disk flight-recorder artifact (postmortem after a SIGKILL)."""
+        return self.spec.flight
+
     def sigkill(self) -> None:
         self.proc.send_signal(signal.SIGKILL)
         self.proc.wait(timeout=10)
@@ -181,6 +202,8 @@ class CellSupervisor:
         edge: bool = False,
         python: Optional[str] = None,
         ready_timeout_s: float = 600.0,
+        http_port: Optional[int] = None,
+        trace_wire: Optional[bool] = None,
     ):
         self.cc = cells or CellsConfig(enabled=True)
         self.n_cells = self.cc.n_cells or max(1, (os.cpu_count() or 2) - 1)
@@ -243,9 +266,42 @@ class CellSupervisor:
                 cfg=dict(cfg_overrides or {}),
                 ledger=ledger,
                 drain_timeout_s=self.cc.drain_timeout_s,
+                flight=os.path.join(base_dir, f"c{k}", "flight.json"),
             )
         self.cells: Dict[int, CellHandle] = {}
         self._thread: Optional[threading.Thread] = None
+
+        # ---- supervisor-side flight-deck gauges: a private registry (the
+        # supervisor may share a process with tests/clients — its series
+        # must not leak into theirs), same compile-out switch as everything
+        self._reg: Registry = (Registry() if metrics_enabled()
+                               else NullRegistry())
+        self._g_up = {k: self._reg.gauge(
+            "cell_up", help="1 if the cell's current incarnation is alive",
+            cell=str(k)) for k in range(self.n_cells)}
+        self._g_restarts = {k: self._reg.gauge(
+            "cell_restarts_total", help="supervisor-initiated respawns",
+            cell=str(k)) for k in range(self.n_cells)}
+        self._g_core = {k: self._reg.gauge(
+            "cell_core_pin", help="pinned CPU core (-1 when unpinned)",
+            cell=str(k)) for k in range(self.n_cells)}
+        for k in range(self.n_cells):
+            core = self.specs[k].core
+            self._g_core[k].set(-1 if core is None else int(core))
+        self._reg.gauge(
+            "supervisor_restart_backoff_seconds",
+            help="respawn backoff between death and relaunch",
+        ).set(float(self.cc.restart_backoff_s))
+        self._reg.gauge(
+            "supervisor_heartbeat_timeout_seconds",
+            help="EWMA failure-detector timeout over the control messenger",
+        ).set(float(self.cc.heartbeat_timeout_s))
+        self._g_fd_down = self._reg.gauge(
+            "supervisor_fd_down_events_total",
+            help="heartbeat down-verdicts observed (fd timeouts)")
+        self.metrics_server: Optional[MetricsServer] = None
+        self._http_port = http_port
+        self._trace_wire = trace_wire
 
     # ---------------------------------------------------------------- spawn
     def start(self) -> "CellSupervisor":
@@ -257,6 +313,10 @@ class CellSupervisor:
         self._thread = threading.Thread(
             target=self._supervise, name="cell-supervisor", daemon=True)
         self._thread.start()
+        if self._http_port is not None and self._http_port >= 0:
+            self.metrics_server = MetricsServer(
+                self.scrape, trace=self._trace_route,
+                port=self._http_port)
         return self
 
     def _on_fd_change(self, node: str, up: bool) -> None:
@@ -264,6 +324,8 @@ class CellSupervisor:
         # live-but-wedged cell surfaces here for operators/tests; actual
         # respawn keys off process death (deterministic under chaos)
         self.fd_events.append((time.monotonic(), node, up))
+        if not up:
+            self._g_fd_down.inc()
 
     def _supervise(self) -> None:
         backoff = max(self.cc.restart_backoff_s, 0.05)
@@ -275,6 +337,7 @@ class CellSupervisor:
                 if self.restarts[k] >= self.cc.max_restarts:
                     continue  # crash-looping cell: leave it down
                 self.restarts[k] += 1
+                self._g_restarts[k].set(self.restarts[k])
                 time.sleep(backoff)
                 if self._stopping:
                     return
@@ -311,6 +374,8 @@ class CellSupervisor:
     def make_client(self, **kw):
         from .. import client as client_mod
 
+        if self._trace_wire is not None:
+            kw.setdefault("trace_wire", self._trace_wire)
         return client_mod.ReconfigurableAppClient(
             self.merged_nodes(), placement_table=self.router, **kw)
 
@@ -325,9 +390,60 @@ class CellSupervisor:
                 except Exception:
                     pass  # a dead cell re-learns via its restart spec
 
+    # ------------------------------------------------------------ flight deck
+    def scrape(self) -> str:
+        """One host-level Prometheus body: supervisor gauges plus every
+        live cell's export (each worker renders its own registry with a
+        ``cell="k"`` label over the control socket), merged with HELP/TYPE
+        metadata deduplicated.  Dead/backing-off cells are simply absent —
+        their ``cell_up`` gauge says why."""
+        bodies = []
+        for k, h in sorted(self.cells.items()):
+            up = h.alive()
+            self._g_up[k].set(1 if up else 0)
+            if not up:
+                continue
+            try:
+                bodies.append(h.metrics(timeout=15))
+            except Exception:
+                self._g_up[k].set(0)  # died mid-scrape
+        sup = render_registry(self._reg, extra_labels={"node": SUP_ID})
+        return merge_scrapes([sup] + bodies)
+
+    def trace(self, tid: Optional[str] = None) -> dict:
+        """Cross-process timeline merge: this process's shared-namespace
+        store (the client side usually lives here) plus every live cell's
+        dump.  Hop clocks are per-process monotonic — entries keep their
+        origin so consumers don't compare timestamps across processes."""
+        merged: Dict[str, list] = {}
+
+        def fold(origin: str, dump: dict) -> None:
+            for rid, evs in dump.items():
+                if tid is not None and rid != str(tid):
+                    continue
+                merged.setdefault(rid, []).extend(
+                    [[origin] + list(ev) for ev in evs])
+
+        fold(SUP_ID, reqtrace.dump_ns())
+        for k, h in sorted(self.cells.items()):
+            if not h.alive():
+                continue
+            try:
+                fold(f"c{k}", h.trace(tid, timeout=15))
+            except Exception:
+                pass  # a cell dying mid-dump only narrows the timeline
+        return merged
+
+    def _trace_route(self, tid: Optional[str]) -> dict:
+        # /trace -> recent ids; /trace/<tid> -> one merged timeline
+        return self.trace(tid)
+
     # ----------------------------------------------------------------- stop
     def stop(self) -> None:
         self._stopping = True
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+            self.metrics_server = None
         if self._thread is not None:
             self._thread.join(timeout=10)
         for h in self.cells.values():
@@ -345,5 +461,11 @@ class CellSupervisor:
 def build_supervisor(cfg: GigapaxosTpuConfig, base_dir: str,
                      **kw) -> CellSupervisor:
     """Config-driven constructor (server.py ``--cells`` bootstrap): the
-    ``cfg.cells`` section sizes and tunes the plane."""
+    ``cfg.cells`` section sizes and tunes the plane; ``cfg.obs`` wires the
+    host-level scrape endpoint."""
+    obs = getattr(cfg, "obs", None)
+    if obs is not None and obs.sup_http_port >= 0:
+        kw.setdefault("http_port", obs.sup_http_port)
+    if obs is not None and obs.trace_wire:
+        kw.setdefault("trace_wire", True)
     return CellSupervisor(base_dir, cells=cfg.cells, **kw)

@@ -21,6 +21,8 @@ Spawned as ``python -m gigapaxos_tpu.cells.worker '<spec json>'`` with::
    "paxos": {"max_groups": 16},                     # cfg.paxos attr overrides
    "cfg": {"native_journal": true},                 # top-level cfg overrides
    "ledger": true,                                  # record (r,name,slot,rid)
+   "flight": ".../flight.json",                     # crash recorder artifact
+   "stats_interval_s": 2.0,                         # StatsReporter cadence
    "drain_timeout_s": 10.0}
 
 Line protocol on stdin/stdout (the Mode B worker's idiom, extended):
@@ -29,6 +31,10 @@ Line protocol on stdin/stdout (the Mode B worker's idiom, extended):
   propose <name> <hex>          -> (async) "resp <rid> <hex|NONE>"
   db [r]                        -> "db <json>" (replica r's app state)
   stats                         -> "stats <json>"
+  metrics                       -> "metrics <json>" (Prometheus text body,
+                                    every series labelled cell="k")
+  trace [tid]                   -> "trace <json>" (cross-process trace dump)
+  flight                        -> "flight <path>" (force a recorder dump)
   ledger                        -> "ledger <json>" (execution observations)
   drain                         -> "drained ok|timeout"
   override <name> <cell>        -> "override_ok <name>" (edge routing)
@@ -101,7 +107,15 @@ def main() -> None:
     from gigapaxos_tpu.net.failure_detection import FailureDetection
     from gigapaxos_tpu.net.messenger import Messenger
     from gigapaxos_tpu.node import InProcessCluster
+    from gigapaxos_tpu.obs import registry as obs_registry
+    from gigapaxos_tpu.obs.flight import FlightRecorder
+    from gigapaxos_tpu.obs.prom import render_registry
     from gigapaxos_tpu.reconfiguration import packets as pkt
+    from gigapaxos_tpu.utils import reqtrace
+    from gigapaxos_tpu.utils.observability import (StatsReporter,
+                                                   node_stats_source,
+                                                   shard_load_source,
+                                                   transport_stats_source)
 
     from .routing import cell_of
 
@@ -145,6 +159,26 @@ def main() -> None:
     # a (non-monitoring) detector registers on AR0's messenger
     fd = FailureDetection(ar0.m, monitored=())
 
+    # ------------------------------------------------- flight deck
+    # crash flight recorder: a SIGKILL'd cell leaves its last ring of
+    # stats snapshots and events on disk for the supervisor/chaos log
+    flight_path = spec.get("flight") or os.path.join(
+        os.path.dirname(spec["wal_dir"]), "flight.json")
+    flight = FlightRecorder(flight_path, cap=cfg.obs.flight_cap,
+                            node=f"c{cell}")
+    flight.install_signal()      # SIGUSR2 -> on-demand dump
+    flight.install_excepthook()  # crash-by-exception -> dump
+    flight.record("boot", cell=cell, pid=os.getpid(),
+                  core=spec.get("core"))
+    reporter = StatsReporter(
+        f"c{cell}", interval_s=float(spec.get("stats_interval_s", 2.0)),
+        sink=flight.snapshot_sink)
+    reporter.add_source("ar", node_stats_source(cluster.manager))
+    reporter.add_source("rc", node_stats_source(cluster.rc_manager))
+    reporter.add_source("transport", transport_stats_source(ar0.m.transport))
+    reporter.add_source("shards", shard_load_source(cluster.manager))
+    reporter.start()
+
     # migrated-name directory for edge routing, updated by `override` lines
     overrides: dict = {str(k): int(v)
                        for k, v in (spec.get("overrides") or {}).items()}
@@ -160,6 +194,8 @@ def main() -> None:
         edge_m = Messenger(f"c{cell}.EDGE", (host, int(port)),
                            cluster.nodemap, reuse_port=True)
 
+        xt = reqtrace.xtracer()
+
         def on_edge_request(sender: str, p: dict) -> None:
             name = p.get("name", "")
             owner = overrides.get(name)
@@ -169,6 +205,10 @@ def main() -> None:
             if owner == cell:
                 ar0._on_app_request(sender, p)
             else:
+                tid = p.get("trace")
+                if tid is not None:
+                    xt.event(tid, "edge_forward", src=cell, dst=owner,
+                             name=name)
                 edge_m.send(f"c{owner}.AR0", p)
 
         edge_m.register(pkt.APP_REQUEST, on_edge_request)
@@ -220,6 +260,22 @@ def main() -> None:
                     "groups": len(list(m.rows.names())),
                     "overrides": dict(overrides),
                 }, sort_keys=True))
+            elif cmd == "metrics":
+                # per-cell export for the supervisor's host-level scrape:
+                # every series this process owns, labelled with its cell
+                body = render_registry(obs_registry(),
+                                       extra_labels={"cell": str(cell)})
+                emit("metrics " + json.dumps(body))
+            elif cmd == "trace":
+                if len(parts) > 1:
+                    tid = parts[1]
+                    dump = {k: v for k, v in reqtrace.dump_ns().items()
+                            if k == tid}
+                else:
+                    dump = reqtrace.dump_ns()
+                emit("trace " + json.dumps(dump))
+            elif cmd == "flight":
+                emit("flight " + flight.dump("rpc"))
             elif cmd == "ledger":
                 with _LEDGER_LOCK:
                     emit("ledger " + json.dumps(_LEDGER))
@@ -272,6 +328,8 @@ def main() -> None:
         except Exception as e:
             emit(f"err {cmd} {type(e).__name__}: {e}")
 
+    reporter.stop()
+    flight.dump("graceful_exit")
     fd.close()
     if edge_m is not None:
         edge_m.close()

@@ -31,6 +31,7 @@ from ..config import GigapaxosTpuConfig
 from ..models.replicable import Replicable
 from ..types import NO_REQUEST
 from ..utils.intmap import RowAllocator
+from ..obs.phase import phase_clock as _phase_clock
 from ..utils.locking import ContendedLock, locked as _locked
 from . import state as st
 from .tick import (ChainInbox, HostChainOutbox, chain_tick_packed,
@@ -84,6 +85,7 @@ class ChainManager:
         self._in_stp = np.zeros((self.P, self.G), bool)
         self._placed: list = []
         self.lock = ContendedLock()
+        self._pc = _phase_clock("chain")
         if self.wal is not None:
             self.wal.attach(self)
 
@@ -213,13 +215,19 @@ class ChainManager:
 
     @_locked
     def tick(self) -> HostChainOutbox:
+        pc = self._pc
+        pc.begin()
         inbox = self._build_inbox()
+        pc.mark("intake")
         # dispatch first, journal second: the WAL fsync overlaps the async
         # device step (see paxos/manager.py tick)
         self.state, packed = chain_tick_packed(self.state, inbox)
+        pc.mark("dispatch")
         if self.wal is not None:
             self.wal.log_inbox(self.tick_num, inbox)
+        pc.mark("wal_fsync")
         out = unpack_chain_outbox(packed, self.R, self.P, self.W, self.G)
+        pc.mark("tally")
         self._process_outbox(out)
         self.tick_num += 1
         if self.wal is not None:
@@ -227,6 +235,8 @@ class ChainManager:
         self._flush_callbacks()
         if self.tick_num % 64 == 0:
             self._sweep_outstanding()
+        pc.mark("execute")
+        pc.end()
         return out
 
     def _flush_callbacks(self) -> None:

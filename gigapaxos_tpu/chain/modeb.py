@@ -51,6 +51,7 @@ from ..net.messenger import Messenger
 from ..net.transport import SendFailure
 from ..types import GroupStatus, NO_REQUEST
 from ..utils.intmap import RowAllocator
+from ..obs.phase import phase_clock as _phase_clock
 from ..utils.locking import ContendedLock
 from ..utils.reqtrace import tracer as _reqtrace
 from . import state as st
@@ -272,6 +273,8 @@ class ChainModeBNode(ModeBCommon):
         self._staged: collections.deque = collections.deque()
         #: per-request flow tracing (see modeb/manager.py): universe-scoped
         self.reqtrace = _reqtrace(f"chu:{self.members[0]}")
+        #: always-on tick phase clock (obs/phase.py)
+        self._pc = _phase_clock("chain_modeb", plane=str(self.node_id))
         self._pending_whois: set = set()
         self._pending_mirror: list = []
         self._frame_applied_tick: Dict[int, int] = {}
@@ -482,22 +485,29 @@ class ChainModeBNode(ModeBCommon):
 
     # ------------------------------------------------------------------- tick
     def tick(self):
+        pc = self._pc
+        pc.begin()
         with self.lock:
             self._refresh_alive()
             self._flush_mirrors()
             inbox = self._build_inbox()
+            pc.mark("intake")
             # dispatch first, journal second: the WAL fsync overlaps the
             # async device step (see paxos/manager.py tick)
             self.state, packed = self._tick_packed(self.state, inbox)
+            pc.mark("dispatch")
             if self.wal is not None:
                 self.wal.log_inbox(self.tick_num, inbox)
+            pc.mark("wal_fsync")
             out, changed = unpack_chain_node_tick(
                 packed, self.R, self.P, self.W, self.G
             )
+            pc.mark("tally")
             self._process_outbox(out)
             self._dirty |= changed
             self.tick_num += 1
             frames = self._build_frames()
+            pc.mark("outbox_pack")
             if self.wal is not None:
                 self.wal.maybe_checkpoint()
             self._release_committed()
@@ -506,6 +516,7 @@ class ChainModeBNode(ModeBCommon):
                 self._check_laggard()
             if self.tick_num % 64 == 0:
                 self._sweep()
+            pc.mark("execute")
         if frames and self.m is not None:
             # identical frame list for every peer: one container, one
             # transport frame (and one writev) per peer per tick
@@ -517,6 +528,8 @@ class ChainModeBNode(ModeBCommon):
                         self.m.send_bytes(peer, batch)
                     except SendFailure:
                         self.stats["send_failures"] += 1
+        pc.mark("egress")
+        pc.end()
         return out
 
     def _build_inbox(self) -> ChainInbox:

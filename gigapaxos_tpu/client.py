@@ -31,7 +31,10 @@ from typing import Callable, Dict, List, Optional, Tuple
 from .config import NodeConfig
 from .net import binbatch
 from .net.messenger import Messenger, NodeMap
+from .obs.metrics import registry as _obs_registry
 from .reconfiguration import packets as pkt
+from .utils.reqtrace import XNS as _XNS
+from .utils.reqtrace import new_trace_id, tracer as _tracer
 
 
 class ClientError(Exception):
@@ -48,6 +51,7 @@ class ReconfigurableAppClient:
         explore_prob: float = 0.1,
         security=None,
         placement_table=None,
+        trace_wire: "bool | None" = None,
     ):
         """``security``: a ``TransportSecurity`` for TLS deployments — under
         MUTUAL_AUTH it must carry a CA-signed client certificate (the
@@ -113,6 +117,24 @@ class ReconfigurableAppClient:
         self._batch_sent: "collections.OrderedDict[int, tuple]" = (
             collections.OrderedDict()
         )
+        #: commit-latency SLO histogram (client-observed RTT; the AR-side
+        #: twin is commit_latency_seconds in reconfiguration/active_replica)
+        self._lat_h = _obs_registry().histogram(
+            "client_commit_latency_seconds",
+            help="client-observed request->response latency")
+        self._batch_lat_h = _obs_registry().histogram(
+            "client_batch_rtt_seconds",
+            help="per-batch-frame round-trip latency")
+        #: cross-process tracing: when enabled (GPTPU_REQTRACE, or set
+        #: ``client.trace.enabled = True``), app requests carry a trace id
+        #: on the wire ("trace") that every hop records against — see
+        #: utils/reqtrace.py "Cross-process tracing"
+        self.trace = _tracer(_XNS)
+        if trace_wire is not None:  # cfg.obs.trace_wire plumbs through here
+            self.trace.enabled = bool(trace_wire)
+        self._trace_ids: "collections.OrderedDict[int, int]" = (
+            collections.OrderedDict()
+        )
 
     def close(self) -> None:
         self.m.close()
@@ -125,6 +147,17 @@ class ReconfigurableAppClient:
 
     def _stamp(self, p: dict) -> dict:
         p["client_addr"] = [self.addr[0], self.addr[1]]
+        if self.trace.enabled and p.get("type") == pkt.APP_REQUEST:
+            rid = p.get("rid")
+            with self._lock:
+                # retries reuse the rid AND the trace id: one timeline
+                tid = self._trace_ids.get(rid)
+                if tid is None:
+                    tid = self._trace_ids[rid] = new_trace_id()
+                    while len(self._trace_ids) > 4096:
+                        self._trace_ids.popitem(last=False)
+            p["trace"] = tid
+            self.trace.event(tid, "client_sent", req=rid, name=p.get("name"))
         return p
 
     def _on_response(self, sender: str, p: dict) -> None:
@@ -141,8 +174,10 @@ class ReconfigurableAppClient:
                     del self._sent_at[rid]
                     node, t0 = sa
                     rtt = time.monotonic() - t0
+                    self._lat_h.observe(rtt)
                     prev = self._rtt.get(node)
                     self._rtt[node] = rtt if prev is None else 0.875 * prev + 0.125 * rtt
+                tid = self._trace_ids.pop(rid, None)
                 cb = self._callbacks.pop(rid, None)
                 self._cb_deadline.pop(rid, None)
                 if cb is None:
@@ -150,6 +185,9 @@ class ReconfigurableAppClient:
                     while len(self._results) > self._results_cap:
                         self._results.popitem(last=False)
                     self._cv.notify_all()
+        if rid is not None and tid is not None:
+            self.trace.event(tid, "client_responded", req=rid,
+                             ok=bool(p.get("ok")))
         if cb is not None:
             cb(p)
 
@@ -496,6 +534,7 @@ class ReconfigurableAppClient:
             return
         target, t0 = ent
         rtt = time.monotonic() - t0
+        self._batch_lat_h.observe(rtt)
         with self._lock:
             prev = self._rtt.get(target)
             self._rtt[target] = (rtt if prev is None

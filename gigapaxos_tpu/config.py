@@ -314,6 +314,39 @@ class SSLConfig:
 
 
 @dataclass
+class ObsConfig:
+    """Flight-deck plane (obs/): scrape endpoints, tracing, flight recorder.
+
+    Properties keys: ``obs.http_port=9464``, ``obs.trace_wire=true``, ...
+    Metric *recording* is compiled in/out by the ``GPTPU_METRICS`` env var
+    (read once at process start — it swaps no-op metric objects in at
+    construction time, so it cannot be a config field).
+    """
+
+    # Per-node Prometheus scrape endpoint port (server.py / ModeBServer):
+    # -1 = off, 0 = ephemeral (tests; actual port is logged), >0 = fixed.
+    http_port: int = -1
+    # Host-level supervisor scrape endpoint (cells): one /metrics merging
+    # every cell with per-cell labels + supervisor gauges.  Same semantics.
+    sup_http_port: int = -1
+    # Stamp client app requests with a cross-process trace id ("trace" wire
+    # key); equivalent to GPTPU_REQTRACE on the client process.
+    trace_wire: bool = False
+    # Opt-in exact device phase timing: block on the dispatch result and
+    # record a "device_step" phase (costs the pipeline overlap — bench-style
+    # measurement, not for production).
+    blocking_phases: bool = False
+    # Flight recorder: ring capacity and artifact directory ("" = alongside
+    # the WAL / base dir of whatever plane hosts the recorder).
+    flight_cap: int = 256
+    flight_dir: str = ""
+
+    def __post_init__(self) -> None:
+        if self.flight_cap < 8:
+            raise ValueError(f"obs.flight_cap must be >= 8, got {self.flight_cap}")
+
+
+@dataclass
 class NodeConfig:
     """Cluster topology: node id -> (host, port).
 
@@ -350,6 +383,7 @@ class GigapaxosTpuConfig:
     fd: FailureDetectionConfig = field(default_factory=FailureDetectionConfig)
     ssl: SSLConfig = field(default_factory=SSLConfig)
     cells: CellsConfig = field(default_factory=CellsConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
     nodes: NodeConfig = field(default_factory=NodeConfig)
     # WAL directory; None = in-memory only (tests).
     log_dir: str | None = None
@@ -419,7 +453,7 @@ def load_properties(path: str) -> GigapaxosTpuConfig:
 
 def apply_env_overrides(cfg: GigapaxosTpuConfig) -> None:
     """Apply ``GPTPU_<SECTION>_<FIELD>`` environment overrides and re-validate."""
-    for sub_name in ("paxos", "placement", "fd", "ssl", "cells"):
+    for sub_name in ("paxos", "placement", "fd", "ssl", "cells", "obs"):
         sub = getattr(cfg, sub_name)
         for f_ in dataclasses.fields(sub):
             env = os.environ.get(f"GPTPU_{sub_name.upper()}_{f_.name.upper()}")
@@ -430,7 +464,7 @@ def apply_env_overrides(cfg: GigapaxosTpuConfig) -> None:
 
 def validate(cfg: GigapaxosTpuConfig) -> None:
     """Re-run dataclass validation (setattr bypasses ``__post_init__``)."""
-    for sub_name in ("paxos", "placement", "fd", "ssl", "cells"):
+    for sub_name in ("paxos", "placement", "fd", "ssl", "cells", "obs"):
         sub = getattr(cfg, sub_name)
         post = getattr(sub, "__post_init__", None)
         if post is not None:

@@ -148,7 +148,7 @@ class ModeBLogger(PaxosLogger):
         must re-grow the state arrays before any later record that assumes
         the larger R."""
         self.journal.append(records.dumps((OP_EXPAND, list(new_ids))))
-        self.journal.sync()
+        self._sync()
 
     def log_frame(self, payload: bytes) -> None:
         """Journal an applied replica frame (before mirror mutation; rides
@@ -158,7 +158,7 @@ class ModeBLogger(PaxosLogger):
     def log_taint(self, name: str) -> None:
         """Journal a taint mark (out-of-tick mutation, like log_ckpt)."""
         self.journal.append(records.dumps((OP_TAINT, name)))
-        self.journal.sync()
+        self._sync()
 
     def log_payload(self, rid: int, payload: bytes, stop: bool) -> None:
         """Journal an out-of-band payload fill (undigest reply): it changes
@@ -169,7 +169,7 @@ class ModeBLogger(PaxosLogger):
         """Journal an adopted checkpoint transfer — it mutates own-row state
         outside the deterministic tick, so replay must re-apply it."""
         self.journal.append(records.dumps((OP_CKPT, gid, dict(packet))))
-        self.journal.sync()
+        self._sync()
 
     def log_inbox(self, tick_num: int, inbox) -> None:
         m = self.manager
@@ -192,12 +192,12 @@ class ModeBLogger(PaxosLogger):
             if entries:
                 placed.append((row, entries))
         alive = np.asarray(inbox.alive).tobytes()
-        self.journal.append(
-            records.dumps((OP_TICK, tick_num, placed, alive))
-        )
+        rec_bytes = records.dumps((OP_TICK, tick_num, placed, alive))
+        self.journal.append(rec_bytes)
+        self._append_bytes.inc(len(rec_bytes))
         self._ticks_since_sync += 1
         if self._ticks_since_sync >= self.sync_every:
-            self.journal.sync()
+            self._sync()
             self._ticks_since_sync = 0
 
     def _meta(self, m) -> dict:

@@ -43,6 +43,7 @@ from ..net.transport import SendFailure
 from ..ops.tick import TickInbox
 from ..types import GroupStatus, NO_REQUEST
 from ..utils.intmap import RowAllocator
+from ..obs.phase import phase_clock as _phase_clock
 from ..utils.locking import ContendedLock
 from ..utils.reqtrace import tracer as _reqtrace
 from ..paxos import state as st
@@ -176,6 +177,9 @@ class ModeBNode(ModeBCommon):
         #: id in one process share a namespace; their slot-tagged rids can
         #: then collide — acceptable for a debug facility.)
         self.reqtrace = _reqtrace(f"mbu:{self.members[0]}")
+        #: always-on tick phase clock (obs/phase.py); host timestamps only —
+        #: the device wait lands in "tally" at the unpack sync point
+        self._pc = _phase_clock("modeb", plane=str(self.node_id))
         # ---- digest-only accepts (PendingDigests.java:23) ----
         self._digest_accepts = bool(cfg.paxos.digest_accepts)
         #: rid -> stop flag for digest proposals whose payload has not
@@ -745,6 +749,8 @@ class ModeBNode(ModeBCommon):
 
     # ------------------------------------------------------------------- tick
     def tick(self):
+        pc = self._pc
+        pc.begin()
         with self.lock:
             self._refresh_alive()
             self._flush_mirrors()
@@ -756,8 +762,10 @@ class ModeBNode(ModeBCommon):
                 p = self._pending_out
                 self._pending_out = None
                 self._complete_tick(*p)
+            pc.mark("ingress")
             inbox = self._build_inbox()
             placed = self._placed
+            pc.mark("intake")
             # dispatch first, journal second: the WAL append+fsync overlaps
             # the async device step (BatchedLogger overlap, SURVEY §2.2
             # item 3); responses stay held until is_synced()
@@ -771,8 +779,10 @@ class ModeBNode(ModeBCommon):
                 )
             else:
                 self.state, packed = self._tick_packed(self.state, inbox)
+            pc.mark("dispatch")
             if self.wal is not None:
                 self.wal.log_inbox(self.tick_num, inbox)
+            pc.mark("wal_fsync")
             self.tick_num += 1
             if self.cfg.paxos.pipeline_ticks:
                 # stage-3 overlap: execute the PREVIOUS tick's decision
@@ -782,7 +792,9 @@ class ModeBNode(ModeBCommon):
                     self._pending_out = None  # callbacks may re-enter a
                     # drain path; never double-process
                     self._complete_tick(p_out, p_placed, p_extras)
+                pc.mark("execute")
                 out, changed, extras = self._unpack_tick(packed)
+                pc.mark("tally")
                 self._pending_out = (out, placed, extras)
                 self._dirty |= changed
                 if self.wal is not None and self.wal.checkpoint_due():
@@ -791,12 +803,15 @@ class ModeBNode(ModeBCommon):
                     self.drain_pipeline()
             else:
                 out, changed, extras = self._unpack_tick(packed)
+                pc.mark("tally")
                 self._dirty |= changed
                 self._complete_tick(out, placed, extras)
+                pc.mark("execute")
             if (self.cfg.paxos.deactivation_ticks > 0
                     and self.tick_num % 256 == 0 and len(self.rows) > 0):
                 self.pause_idle()
             frames = self._build_frames()
+            pc.mark("outbox_pack")
             if self.wal is not None:
                 self.wal.maybe_checkpoint()
         if frames and self.m is not None:
@@ -817,6 +832,8 @@ class ModeBNode(ModeBCommon):
                         # transport closing underneath a final tick — the
                         # anti-entropy full frame re-ships state anyway
                         self.stats["send_failures"] += 1
+        pc.mark("egress")
+        pc.end()
         return out
 
     def _build_inbox(self) -> TickInbox:

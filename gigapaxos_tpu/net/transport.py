@@ -36,6 +36,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ..obs.metrics import registry as _obs_registry
 from ..utils.profiler import profiler
 from .security import TransportSecurity
 
@@ -256,6 +257,7 @@ class _Peer:
                             break
                         # interruptible: close()/reset_peer set wake so a
                         # dead link's backoff never stalls shutdown/reset
+                        self.t._count("reconnect_backoffs")
                         self.wake.wait(min(backoff * (2 ** attempts), 2.0))
                         self.wake.clear()
                         continue
@@ -270,6 +272,7 @@ class _Peer:
                     n_sys = _send_frames(self.sock, batch)
                     self.t._count("sent", len(batch))
                     self.t._count("send_syscalls", n_sys)
+                    self.t._batch_h.observe(len(batch))
                     break
                 except (OSError, struct.error):
                     try:
@@ -338,6 +341,16 @@ class Transport:
         self._plock = threading.Lock()
         self.stats: Dict[str, int] = {}
         self._slock = threading.Lock()
+        # every _count key mirrors into the metrics registry as
+        # transport_<key>_total{node=}; the dict stays (tests + the
+        # StatsReporter transport source read it), the registry is what the
+        # scrape endpoint exports.  Frames-per-syscall derives from
+        # sent/send_syscalls (and recv_frames/recv_syscalls) server-side.
+        self._obs_counters: Dict[str, object] = {}
+        self._batch_h = _obs_registry().histogram(
+            "transport_writev_batch_frames",
+            help="frames coalesced into one writev batch",
+            unit="", node=node_id)
 
         # reuse_port=True: every serving cell of a host binds the same edge
         # port and the kernel load-balances accepts across them (cells/)
@@ -472,6 +485,11 @@ class Transport:
     def _count(self, key: str, n: int = 1) -> None:
         with self._slock:
             self.stats[key] = self.stats.get(key, 0) + n
+            c = self._obs_counters.get(key)
+            if c is None:
+                c = self._obs_counters[key] = _obs_registry().counter(
+                    f"transport_{key}_total", node=self.node_id)
+        c.inc(n)
 
     def reset_peer(self, dest: str) -> None:
         """Discard everything queued — or held by the writer mid-retry — for

@@ -429,7 +429,7 @@ class InProcessCluster:
             if wal is not None:
                 try:
                     if wal.journal is not None:
-                        wal.journal.sync()
+                        wal._sync()
                     wal.close()
                 except Exception:
                     pass

@@ -1,0 +1,91 @@
+"""The scrape endpoint: a tiny threaded HTTP server (stdlib only).
+
+Routes:
+
+* ``GET /metrics``      -> Prometheus text (the ``scrape`` callback)
+* ``GET /trace/<tid>``  -> JSON timeline for one trace id (``trace`` cb)
+* ``GET /trace``        -> JSON list of recent trace ids
+* ``GET /flight``       -> JSON flight-recorder ring (``flight`` cb)
+
+Bound to ``127.0.0.1`` by default — operators front it with their own
+ingress; port 0 picks an ephemeral port (tests), ``.port`` reports it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+
+class MetricsServer:
+    def __init__(self, scrape: Callable[[], str],
+                 trace: Optional[Callable[[Optional[str]], object]] = None,
+                 flight: Optional[Callable[[], object]] = None,
+                 port: int = 0, host: str = "127.0.0.1"):
+        self._scrape = scrape
+        self._trace = trace
+        self._flight = flight
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # no stderr chatter
+                pass
+
+            def _send(self, code: int, body: str, ctype: str) -> None:
+                data = body.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                try:
+                    path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                    if path == "/metrics":
+                        self._send(200, outer._scrape(),
+                                   "text/plain; version=0.0.4")
+                    elif path == "/trace" and outer._trace is not None:
+                        self._send(200, json.dumps(outer._trace(None)),
+                                   "application/json")
+                    elif (path.startswith("/trace/")
+                          and outer._trace is not None):
+                        tid = path[len("/trace/"):]
+                        self._send(200, json.dumps(outer._trace(tid)),
+                                   "application/json")
+                    elif path == "/flight" and outer._flight is not None:
+                        self._send(200, json.dumps(outer._flight()),
+                                   "application/json")
+                    else:
+                        self._send(404, "not found\n", "text/plain")
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # a broken source must not kill serve
+                    try:
+                        self._send(500, f"{type(e).__name__}: {e}\n",
+                                   "text/plain")
+                    except Exception:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.2},
+            name=f"metrics-http:{self.port}", daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass
+        self._thread.join(timeout=2)

@@ -36,6 +36,8 @@ from ..config import GigapaxosTpuConfig
 from ..models.replicable import Replicable
 from ..types import GroupStatus, NO_REQUEST
 from ..utils.intmap import RowAllocator
+from ..obs.phase import BLOCKING_PHASE as _BLOCKING_PHASE
+from ..obs.phase import phase_clock as _phase_clock
 from ..utils.locking import ContendedLock, locked as _locked
 from ..utils.reqtrace import tracer as _reqtrace
 
@@ -317,6 +319,14 @@ class PaxosManager:
         #: manager has its own rid namespace (all start at rid 1), drawn
         #: from a monotonic counter (id() would be reused after GC).
         self.reqtrace = _reqtrace(f"pxm:{next(_MGR_SEQ)}")
+        #: always-on tick phase clock (obs/phase.py): host timestamps only —
+        #: "dispatch" is enqueue cost, the device wait lands in "tally" at
+        #: the unpack sync point, so no device synchronization is added.
+        #: cfg.obs.blocking_phases adds an exact "device_step" phase by
+        #: blocking on the dispatch result (bench-style measurement).
+        self._pc = _phase_clock("modea", plane=spill_ns)
+        self._obs_block = bool(getattr(getattr(cfg, "obs", None),
+                                       "blocking_phases", False))
         # Control-plane threads (messenger readers, protocol tasks) call the
         # admin/propose API while a tick driver loops on tick(); one reentrant
         # lock serializes them (the reference synchronizes on the instance map
@@ -1452,12 +1462,16 @@ class PaxosManager:
         """One manager step.  Returns the tick's :class:`HostOutbox` (full
         mode) / :class:`CompactHostOutbox` (compact mode); in pipelined mode
         the return is the PREVIOUS tick's outbox (None on the first)."""
+        pc = self._pc
+        pc.begin()
         self._run_due_laggard_syncs()
+        pc.mark("repair")
         if self._device_app:
             # descriptor upload rides the same fused program as the tick;
             # watermark must advance BEFORE the build so those rids place
             reg = self._take_kv_uploads()
         inbox = self._build_inbox()
+        pc.mark("intake")
         placed = self._placed
         bulk_placed = self._bulk_placed
         # dispatch first, journal second: the jitted step runs asynchronously
@@ -1528,8 +1542,17 @@ class PaxosManager:
             )
             if fr is not None:
                 frontier = self._frontier_gather(fr)
+        if self._obs_block:
+            # opt-in exact device step (bench.py's cumulative-prefix
+            # measurement, online): costs the overlap the pipeline buys
+            import jax
+
+            jax.block_until_ready(packed)
+            pc.mark(_BLOCKING_PHASE)
+        pc.mark("dispatch")
         if self.wal is not None:
             self.wal.log_inbox(self.tick_num, inbox)
+        pc.mark("wal_fsync")
         self.tick_num += 1
         if self.cfg.paxos.pipeline_ticks:
             # deferred unpack: _pending_out holds the still-on-device packed
@@ -1560,6 +1583,7 @@ class PaxosManager:
             out = self._complete_tick(packed, placed, bulk_placed, frontier)
         if self.wal is not None:
             self.wal.maybe_checkpoint()
+        pc.end()
         return out
 
     def _complete_tick(self, packed, placed: list, bulk_placed=None,
@@ -1567,6 +1591,10 @@ class PaxosManager:
         """Consume one tick's outbox (unpacking = the device sync point):
         requeue rejected intake, execute the ordered decision stream,
         release durable callbacks, periodic GC."""
+        pc = self._pc
+        # re-arm without observing: drain_pipeline completes a deferred tick
+        # outside tick(), and cross-call idle time must not land in "tally"
+        pc.touch()
         if self._use_compact:
             flat = np.asarray(packed)
             out = unpack_compact(flat, self.R, self.G,
@@ -1576,6 +1604,7 @@ class PaxosManager:
                 # extras sliced through the shared layout descriptor —
                 # fused_compact packs them through the same object
                 e_resp, e_miss = self._compact_layout.kv_extras(flat)
+            pc.mark("tally")
             self._process_compact(out, placed, bulk_placed, e_resp, e_miss)
         else:
             if isinstance(packed, HostOutbox):
@@ -1589,8 +1618,11 @@ class PaxosManager:
                 out = fetch_host_outbox(packed)
             else:
                 out = unpack_outbox(packed, self.R, self.P, self.W, self.G)
+            pc.mark("tally")
             self._process_outbox(out, placed, bulk_placed)
+        pc.mark("execute")
         self._flush_callbacks()
+        pc.mark("egress")
         if self.tick_num % self._sweep_every == 0:
             self._sweep_outstanding(frontier)
         if (
@@ -1599,6 +1631,7 @@ class PaxosManager:
             and len(self.rows) > 0
         ):
             self.pause_idle()
+        pc.mark("sweep")
         return out
 
     @_locked

@@ -35,6 +35,8 @@ from ..net import binbatch
 from ..net.bulk import BulkTransfer
 from ..net.messenger import Messenger
 from ..net.transport import SendFailure
+from ..obs.metrics import registry as _obs_registry
+from ..utils.reqtrace import xtracer as _xtracer
 from ..protocoltask.executor import ProtocolExecutor, ProtocolTask
 from . import packets as pkt
 from .consistent_hashing import ConsistentHashRing
@@ -201,6 +203,14 @@ class ActiveReplica:
         )
         self._any_lock = threading.Lock()
         self._any_next = 1 << 40  # disjoint from client rids
+        #: server-side commit-latency SLO histogram: request arrival at this
+        #: replica -> response release (covers propose + tick + WAL + flush)
+        self._lat_h = _obs_registry().histogram(
+            "commit_latency_seconds",
+            help="AR-observed request->response latency", node=node_id)
+        #: cross-process tracing hop: records whenever a frame carries a
+        #: trace id (presence IS the flag — the client side gates stamping)
+        self._xt = _xtracer()
         for ptype, h in [
             (pkt.APP_REQUEST, self._on_app_request),
             (pkt.APP_REQUEST_BATCH, self._on_app_request_batch),
@@ -229,6 +239,9 @@ class ActiveReplica:
         reply_to = p.get("reply_to") or sender
         if (p.get("anycast") and not p.get("fwd")
                 and self.coord.current_epoch(name) is None):
+            tid = p.get("trace")
+            if tid is not None:  # dict forwarded verbatim: the id survives
+                self._xt.event(tid, "ar_forward", node=self.node_id, req=rid)
             self._anycast_forward(reply_to, p)
             return
         sender = reply_to
@@ -292,6 +305,11 @@ class ActiveReplica:
 
     def _handle_app_request(self, sender: str, p: dict, key) -> None:
         name, rid = p["name"], p["rid"]
+        t0 = time.perf_counter()
+        tid = p.get("trace")
+        if tid is not None:
+            self._xt.event(tid, "ar_recv", node=self.node_id, req=rid,
+                           name=name)
         epoch = self.coord.current_epoch(name)
         if epoch is None:
             self._finish_request(sender, key, {
@@ -316,7 +334,12 @@ class ActiveReplica:
                         self._req_dedup.pop(key, None)
                     self._dedup_born.pop(key, None)
                 return
-            if req_id < 0 or resp is None:
+            ok = not (req_id < 0 or resp is None)
+            self._lat_h.observe(time.perf_counter() - t0)
+            if tid is not None:
+                self._xt.event(tid, "ar_responded", node=self.node_id,
+                               req=rid, ok=ok)
+            if not ok:
                 # epoch stopped underneath us: client must re-resolve actives
                 self._finish_request(sender, key, {
                     "type": pkt.APP_RESPONSE, "rid": rid, "ok": False,

@@ -221,6 +221,45 @@ class ModeBServer:
                     "rc_net", transport_stats_source(m.transport)
                 )
 
+        # ---------------------------------------------------- flight deck
+        # per-node scrape endpoint + crash flight recorder (cfg.obs); the
+        # serving-cell plane wires the same pieces per worker process
+        self.metrics_server = None
+        self.flight = None
+        obs = getattr(cfg, "obs", None)
+        if obs is not None and obs.flight_dir:
+            from .obs.flight import FlightRecorder
+
+            self.flight = FlightRecorder(
+                os.path.join(obs.flight_dir, f"{node_id}-flight.json"),
+                cap=obs.flight_cap, node=node_id)
+            self.flight.install_excepthook()
+            self.flight.record("boot", node=node_id, pid=os.getpid())
+            if self.reporter is not None:
+                self.reporter.sink = self.flight.snapshot_sink
+        if obs is not None and obs.http_port >= 0:
+            from .obs import registry as _obs_registry
+            from .obs.http import MetricsServer
+            from .obs.prom import render_registry
+            from .utils import reqtrace as _reqtrace
+
+            def _scrape() -> str:
+                return render_registry(_obs_registry(),
+                                       extra_labels={"node": node_id})
+
+            def _trace(tid):
+                d = _reqtrace.dump_ns()
+                return (d if tid is None
+                        else {k: v for k, v in d.items() if k == str(tid)})
+
+            flight_cb = None
+            if self.flight is not None:
+                fr = self.flight
+                flight_cb = lambda: fr.read(fr.persist())  # noqa: E731
+            self.metrics_server = MetricsServer(
+                _scrape, trace=_trace, flight=flight_cb,
+                port=obs.http_port)
+
         if self.reporter is not None:
             self.reporter.start()
 
@@ -304,8 +343,12 @@ class ModeBServer:
         return all(d.wait_ready(timeout_s) for d in self.drivers)
 
     def close(self) -> None:
+        if self.metrics_server is not None:
+            self.metrics_server.close()
         if self.reporter is not None:
             self.reporter.stop()
+        if self.flight is not None:
+            self.flight.dump("close")
         for fd in self.fds:
             fd.close()
         # drivers first: a tick sending frames after the messenger closed

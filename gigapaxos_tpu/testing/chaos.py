@@ -330,7 +330,14 @@ class ProcChaosRunner:
         a, args = ev.action, dict(ev.args)
         info: dict = {}
         if a == "crash":
-            self.procs[args["node"]].sigkill()
+            h = self.procs[args["node"]]
+            # the victim's continuously-persisted flight recorder (obs/
+            # flight.py) survives the SIGKILL; thread its artifact path
+            # into the chaos log so the soak leaves one postmortem per kill
+            fp = getattr(h, "flight_path", None)
+            if fp:
+                info["flight"] = fp
+            h.sigkill()
         elif a == "recover":
             if self.restart is None:
                 raise RuntimeError("recover needs a restart factory")

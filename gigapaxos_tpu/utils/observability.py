@@ -20,9 +20,11 @@ log = logging.getLogger("gigapaxos_tpu.stats")
 
 
 class StatsReporter:
-    def __init__(self, node_id: str, interval_s: float = 10.0):
+    def __init__(self, node_id: str, interval_s: float = 10.0,
+                 sink: "Callable[[dict], None] | None" = None):
         self.node_id = node_id
         self.interval_s = max(interval_s, 0.5)
+        self.sink = sink  # e.g. FlightRecorder.snapshot_sink
         self._sources: Dict[str, Callable[[], dict]] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -56,14 +58,31 @@ class StatsReporter:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2)
+        # reset so a stop/start cycle restarts the loop (supervisor-driven
+        # cell restarts stop the reporter, replay the WAL, then start again)
+        self._thread = None
+        self._stop = threading.Event()
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
-            log.info("%s", json.dumps(self.snapshot(), default=str))
+            snap = self.snapshot()
+            log.info("%s", json.dumps(snap, default=str))
+            sink = self.sink
+            if sink is not None:
+                try:
+                    sink(snap)
+                except Exception:  # a broken sink must not kill the loop
+                    pass
 
 
 def node_stats_source(node) -> Callable[[], dict]:
-    """Standard source for a ModeBNode / ChainModeBNode."""
+    """Standard source for any tick-driven node.
+
+    Duck-typed over the union of ModeBNode / ChainModeBNode / Mode A
+    ``PaxosManager`` shapes: Mode A managers have no ``alive`` mask of their
+    own shape guarantees, use a ``collections.Counter`` for ``stats`` and a
+    ``RowAllocator`` (``names()``) rather than a row dict (``items()``), so
+    each field degrades to present-if-there instead of raising."""
 
     import contextlib
 
@@ -73,13 +92,26 @@ def node_stats_source(node) -> Callable[[], dict]:
         # "changed size during iteration" under load
         lock = getattr(node, "lock", None)
         with (lock if lock is not None else contextlib.nullcontext()):
-            return {
-                "ticks": node.tick_num,
-                "alive": [bool(x) for x in node.alive],
-                "groups": len(list(node.rows.items())),
-                "outstanding": len(node.outstanding),
-                "stats": dict(node.stats),
-            }
+            out = {"ticks": int(getattr(node, "tick_num", 0))}
+            rows = getattr(node, "rows", None)
+            if rows is not None:
+                try:
+                    out["groups"] = sum(1 for _ in rows.names())
+                except AttributeError:
+                    out["groups"] = len(list(rows.items()))
+            outstanding = getattr(node, "outstanding", None)
+            if outstanding is not None:
+                out["outstanding"] = len(outstanding)
+            alive = getattr(node, "alive", None)
+            if alive is not None:
+                out["alive"] = [bool(x) for x in alive]
+            stats = getattr(node, "stats", None)
+            if stats:
+                out["stats"] = dict(stats)
+            paused = getattr(node, "_paused", None)
+            if paused is not None:
+                out["paused"] = len(paused)
+            return out
 
     return snap
 

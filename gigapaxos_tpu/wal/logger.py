@@ -29,12 +29,17 @@ from __future__ import annotations
 import glob
 import io
 import os
+import time
 from typing import List, Optional
 
 import numpy as np
 
 from . import records
+from ..obs.metrics import registry as _obs_registry
 from ..paxos.state import PaxosState
+
+#: fsyncs slower than this count as stalls (the cloud-variance signal).
+FSYNC_STALL_S = float(os.environ.get("GPTPU_FSYNC_STALL_MS", "10")) / 1e3
 
 OP_CREATE = 1
 OP_REMOVE = 2
@@ -74,6 +79,26 @@ class PaxosLogger:
         self.journal = None
         self._ticks_since_sync = 0
         self._ticks_since_ckpt = 0
+        # fsync observability: every durability point goes through _sync()
+        # (tests/test_obs_coverage.py asserts no bare journal.sync() calls)
+        self._fsync_h = _obs_registry().histogram(
+            "wal_fsync_seconds", help="journal fsync wall time")
+        self._fsync_stalls = _obs_registry().counter(
+            "wal_fsync_stalls_total",
+            help=f"fsyncs slower than {FSYNC_STALL_S * 1e3:.0f}ms")
+        self._append_bytes = _obs_registry().counter(
+            "wal_appended_bytes_total", help="journaled tick-record bytes")
+
+    def _sync(self) -> None:
+        """The single durability point: fsync the journal, timed.  Slow
+        fsyncs (> FSYNC_STALL_S) are the cloud-variance signal the paper
+        says dominates tails, so they get their own counter."""
+        t0 = time.perf_counter()
+        self.journal.sync()
+        dt = time.perf_counter() - t0
+        self._fsync_h.observe(dt)
+        if dt >= FSYNC_STALL_S:
+            self._fsync_stalls.inc()
 
     # ------------------------------------------------------------------ wiring
     def attach(self, manager) -> None:
@@ -97,7 +122,7 @@ class PaxosLogger:
     # ----------------------------------------------------------------- logging
     def log_create(self, name: str, members: List[int], epoch: int) -> None:
         self.journal.append(records.dumps((OP_CREATE, name, members, epoch)))
-        self.journal.sync()
+        self._sync()
 
     def log_creates(self, names, members: List[int], epoch: int) -> None:
         """Batched create logging: individual OP_CREATE records (replay is
@@ -106,7 +131,7 @@ class PaxosLogger:
             self.journal.append(
                 records.dumps((OP_CREATE, name, list(members), epoch))
             )
-        self.journal.sync()
+        self._sync()
 
     def log_create_at(self, name: str, members: List[int], epoch: int,
                       row: int, app_seed) -> None:
@@ -118,11 +143,11 @@ class PaxosLogger:
         self.journal.append(records.dumps(
             (OP_CREATE_AT, name, members, epoch, row, app_seed)
         ))
-        self.journal.sync()
+        self._sync()
 
     def log_remove(self, name: str) -> None:
         self.journal.append(records.dumps((OP_REMOVE, name)))
-        self.journal.sync()
+        self._sync()
 
     def log_pause(self, names) -> None:
         """Pause/unpause change row allocation, and journaled tick records
@@ -187,13 +212,13 @@ class PaxosLogger:
             # (they are device-state writes, like the tick itself)
             kv_reg = tuple(a.tobytes() for a in up)
             m._kv_uploaded = None
-        self.journal.append(
-            records.dumps((OP_TICK, tick_num, placed_with_payloads, alive,
-                          bulk, kv_reg))
-        )
+        rec_bytes = records.dumps((OP_TICK, tick_num, placed_with_payloads,
+                                   alive, bulk, kv_reg))
+        self.journal.append(rec_bytes)
+        self._append_bytes.inc(len(rec_bytes))
         self._ticks_since_sync += 1
         if self._ticks_since_sync >= self.sync_every:
-            self.journal.sync()
+            self._sync()
             self._ticks_since_sync = 0
 
     def is_synced(self) -> bool:
@@ -287,8 +312,9 @@ class PaxosLogger:
 
     def checkpoint(self) -> str:
         """Write a full snapshot and roll the journal; GC superseded files."""
+        t_ckpt = time.perf_counter()
         m = self.manager
-        self.journal.sync()
+        self._sync()
         new_seq = m.tick_num
         path = self._snapshot_path(new_seq)
         state_np = {f: np.asarray(getattr(m.state, f)) for f in m.state._fields}
@@ -311,6 +337,9 @@ class PaxosLogger:
         self.seq = new_seq
         self.journal = _new_journal(self._journal_path(new_seq), self.native)
         self._gc(new_seq)
+        _obs_registry().histogram(
+            "wal_checkpoint_seconds", help="snapshot+roll+GC wall time"
+        ).observe(time.perf_counter() - t_ckpt)
         return path
 
     def _gc(self, keep_seq: int) -> None:

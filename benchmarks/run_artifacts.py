@@ -300,6 +300,29 @@ def bench_obs_overhead() -> dict:
     return r
 
 
+def bench_storage_faults() -> dict:
+    """Storage-fault soak (benchmarks/storage_fault_soak.py): refreshes
+    results_storage_faults_pr10.json — randomized bit-flip / torn-write /
+    fsync-error / disk-full schedules with crash+recover-from-damaged-WAL
+    interleaved, across seeds.  Hard gates: zero S1 violations, zero
+    silently lost acked decisions, v2 framing overhead < 2%."""
+    r = _script(["benchmarks/storage_fault_soak.py"], timeout=3600)[-1]
+    if r["total_violations"] or r["total_lost_acked"]:
+        raise RuntimeError(
+            f"storage soak: {r['total_violations']} S1 violations, "
+            f"{r['total_lost_acked']} lost acked decisions")
+    return {
+        "metric": "storage_fault_soak_lost_acked_decisions",
+        "value": r["total_lost_acked"],
+        "unit": f"lost acks over {r['seeds']} seeds "
+                f"({r['total_acked']} acked, "
+                f"{r['total_failstops']} fail-stops)",
+        "outcomes_by_class": r["outcomes_by_class"],
+        "framing_overhead_pct": r["framing_overhead"]["value"],
+        "artifact": r.get("written"),
+    }
+
+
 def bench_cells_capacity() -> dict:
     """Serving-cells capacity sweep (benchmarks/cells_capacity.py):
     refreshes results_capacity_cells_pr8.json (1 -> 2 -> 4 cells with
@@ -379,6 +402,8 @@ def main() -> None:
     run("cells_capacity", bench_cells_capacity)
     # flight-deck plane (PR 9): always-on metrics overhead gate
     run("obs_overhead", bench_obs_overhead)
+    # storage fault plane (PR 10): scribble/tear/fsyncgate/disk-full soak
+    run("storage_faults", bench_storage_faults)
 
     out = args.out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),

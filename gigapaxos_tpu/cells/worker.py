@@ -170,6 +170,21 @@ def main() -> None:
     flight.install_excepthook()  # crash-by-exception -> dump
     flight.record("boot", cell=cell, pid=os.getpid(),
                   core=spec.get("core"))
+
+    # storage fail-stop: a WalFailedError/WalQuarantinedError escaping a
+    # tick loop means the WAL can no longer make acks durable — dump the
+    # flight ring and die nonzero so the supervisor restarts this cell
+    # onto intact storage (replay re-derives state from what DID reach
+    # disk; anything unacked is the client's retry)
+    from gigapaxos_tpu.paxos import driver as _tick_driver_mod
+
+    def _wal_failstop(exc: BaseException) -> None:
+        flight.record("wal_failstop", error=f"{type(exc).__name__}: {exc}")
+        flight.dump("wal_failstop")
+        emit(f"wal_failstop {type(exc).__name__}: {exc}")
+        os._exit(3)
+
+    _tick_driver_mod.FATAL_HANDLER = _wal_failstop
     reporter = StatsReporter(
         f"c{cell}", interval_s=float(spec.get("stats_interval_s", 2.0)),
         sink=flight.snapshot_sink)

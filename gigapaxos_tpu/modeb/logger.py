@@ -27,9 +27,11 @@ import os
 
 import numpy as np
 
+from ..obs.metrics import registry as _obs_registry
 from ..wal import records
 from ..wal.logger import (OP_CREATE, OP_PAUSE, OP_REMOVE, OP_TICK,
-                          OP_UNPAUSE, PaxosLogger)
+                          OP_UNPAUSE, PaxosLogger, WalQuarantinedError,
+                          _load_op, quarantine_journal)
 from .kernel import unpack_node_tick
 
 OP_FRAME = 6
@@ -38,9 +40,30 @@ OP_EXPAND = 8
 OP_PAYLOAD = 9  # out-of-band payload arrival (undigest reply)
 OP_TAINT = 10   # row marked not-authoritative (tainted epoch birth)
 
+#: op byte -> (min_arity, max_arity): fail-closed whitelist applied to
+#: every record decoded from disk (wal/records.py validate_op_record)
+MODEB_OP_SCHEMA = {
+    OP_CREATE: (4, 4),
+    OP_REMOVE: (2, 2),
+    OP_TICK: (4, 4),
+    OP_PAUSE: (2, 2),
+    OP_UNPAUSE: (2, 2),
+    OP_FRAME: (2, 2),
+    OP_CKPT: (3, 3),
+    OP_EXPAND: (2, 2),
+    OP_PAYLOAD: (4, 4),
+    OP_TAINT: (2, 2),
+}
+
+#: ops that are safe to apply out of tick order after corruption cut the
+#: deterministic replay short: externally-sourced data (frames, payloads,
+#: adopted checkpoints) plus taint marks.  OP_TICK and admin ops are NOT
+#: salvageable — their effects depend on every prior record.
+_SALVAGE_OPS = frozenset({OP_FRAME, OP_PAYLOAD, OP_TAINT, OP_CKPT})
+
 
 def replay_node_journals(node, log_dir, start_seq, stage, new_buffers,
-                         place, run_tick) -> None:
+                         place, run_tick) -> bool:
     """Shared Mode B journal-replay loop (paxos + chain node flavors).
 
     The protocol-specific parts are injected: ``stage`` decodes+stages one
@@ -50,96 +73,176 @@ def replay_node_journals(node, log_dir, start_seq, stage, new_buffers,
     skip, rid-counter repair from placed intake, snapshot-queue dedup
     against journaled placements, mirror flushing — is identical across
     flavors and lives here once (the chain flavor previously carried a
-    line-for-line copy)."""
+    line-for-line copy).
+
+    Storage faults: a journal whose scan classifies as *scribble* (mid-log
+    corruption with intact records after it — fsynced, possibly acked data
+    was damaged) is quarantined aside and replay degrades: the intact
+    prefix replays normally, then only externally-sourced records
+    (_SALVAGE_OPS) are applied from the intact suffix and any later
+    journals, because the deterministic tick stream is broken at the
+    corruption point.  Returns True in that case — the caller must taint
+    every own row so the existing laggard-repair machinery re-fetches
+    authoritative state from peers (and must fail-stop instead when no
+    peer exists).  Undecodable records are tolerated ONLY in the unsynced
+    tail of the newest journal (past the last fsync barrier: never acked);
+    anywhere else they are corruption, not a crash artifact."""
     import collections
 
-    from ..wal.journal import read_journal
+    from ..wal.journal import scan_journal
     from .common import RID_MASK, rid_origin
 
-    for path in sorted(glob.glob(os.path.join(log_dir, "journal.*.log"))):
+    corrupt_c = _obs_registry().counter(
+        "wal_corrupt_records_total",
+        help="corrupt journal records/regions found at recovery")
+    tolerated_c = _obs_registry().counter(
+        "wal_replay_tolerated_frames_total",
+        help="undecodable records tolerated in the unsynced tail")
+    import logging
+
+    log = logging.getLogger("gptpu.wal")
+    degraded = False
+
+    def dispatch(rec, idx, scan, newest):
+        nonlocal degraded
+        op = rec[0]
+        if degraded and op not in _SALVAGE_OPS:
+            return
+        if op == OP_CREATE:
+            _, name, members, epoch = rec
+            if name not in node.rows:
+                node.create_group(name, members, epoch)
+        elif op == OP_EXPAND:
+            node.expand_universe(rec[1], _log=False)
+        elif op == OP_REMOVE:
+            node.remove_group(rec[1])
+        elif op == OP_PAUSE:
+            node._do_pause([n for n in rec[1] if n in node.rows])
+        elif op == OP_UNPAUSE:
+            node._unpause(rec[1])
+        elif op == OP_FRAME:
+            try:
+                stage(rec[1])
+            except (ValueError, IndexError) as e:
+                corrupt_c.inc()
+                if newest and idx >= scan.n_synced:
+                    # unsynced tail of the journal being appended at crash
+                    # time: the frame was never covered by an fsync, so
+                    # nothing acked depends on it
+                    tolerated_c.inc()
+                elif not degraded:
+                    # mid-log: an fsynced frame decoded to garbage.  The
+                    # live run staged it, so own state evolved from it —
+                    # every tick after this point would diverge silently.
+                    log.error("journal frame %d is fsynced but "
+                              "undecodable (%s): degrading to peer repair",
+                              idx, e)
+                    degraded = True
+        elif op == OP_PAYLOAD:
+            _, rid, pl, stop = rec
+            if rid not in node.outstanding and rid not in node.payloads:
+                node._store_payload(rid, pl, stop)
+        elif op == OP_TAINT:
+            # a tainted birth must survive the crash: an untainted
+            # recovered row with empty state would serve bad reads AND
+            # donate the empty state to tainted peers (state loss)
+            row = node.rows.row(rec[1])
+            if row is not None:
+                node._tainted_rows.add(row)
+        elif op == OP_CKPT:
+            _, gid, packet = rec
+            row = node._gid_row.get(gid)
+            if row is not None:
+                node._apply_ckpt(row, packet)
+        elif op == OP_TICK:
+            _, tick_num, placed, alive_b = rec
+            if tick_num < node.tick_num:
+                return  # already inside the snapshot
+            bufs = new_buffers()
+            node._placed = []
+            for row, entries in placed:
+                take = []
+                placed_rids = set()
+                for rid, p, payload, stop in entries:
+                    if rid_origin(rid) == node.r:
+                        node._next_seq = max(
+                            node._next_seq, (rid & RID_MASK) + 1
+                        )
+                    placed_rids.add(rid)
+                    # payload None = digest-only placement (the rid was
+                    # placed before its payload arrived); replay places
+                    # it identically and execution follows the same
+                    # learned-payload / taint path as the live run
+                    if payload is not None and (
+                        rid not in node.outstanding
+                        and rid not in node.payloads
+                    ):
+                        node._store_payload(rid, payload, stop)
+                    place(bufs, p, row, rid, stop)
+                    take.append((rid, p))
+                node._placed.append((row, take))
+                # snapshot queues may hold copies of rids whose placement
+                # is journaled after it — drop or they commit twice
+                if row in node._queues and placed_rids:
+                    node._queues[row] = collections.deque(
+                        r for r in node._queues[row]
+                        if r not in placed_rids
+                    )
+            node._flush_mirrors()  # frames staged since the last tick
+            out, changed = run_tick(
+                bufs, np.frombuffer(alive_b, dtype=bool)
+            )
+            node._process_outbox(out)
+            drain = getattr(node, "_drain_stalled", None)
+            if drain is not None:  # digest-mode stalls release as the
+                drain()            # journaled payload arrivals replay
+            node._dirty |= changed
+            node.tick_num = tick_num + 1
+
+    paths = sorted(glob.glob(os.path.join(log_dir, "journal.*.log")))
+    for path in paths:
         seq = int(os.path.basename(path).split(".")[1])
         if seq < start_seq:
             continue
-        for raw in read_journal(path):
-            rec = records.loads(raw)
-            op = rec[0]
-            if op == OP_CREATE:
-                _, name, members, epoch = rec
-                if name not in node.rows:
-                    node.create_group(name, members, epoch)
-            elif op == OP_EXPAND:
-                node.expand_universe(rec[1], _log=False)
-            elif op == OP_REMOVE:
-                node.remove_group(rec[1])
-            elif op == OP_PAUSE:
-                node._do_pause([n for n in rec[1] if n in node.rows])
-            elif op == OP_UNPAUSE:
-                node._unpause(rec[1])
-            elif op == OP_FRAME:
+        newest = path == paths[-1]
+        scan = scan_journal(path)
+        # a tear is only innocent in the newest journal (the one being
+        # appended at crash time); rolled journals were sealed by their
+        # closing fsync, so missing bytes there are lost fsynced data
+        bad = scan.kind == "scribble" or (
+            scan.kind == "torn_tail" and not newest
+            and scan.good_len < scan.file_size)
+        for idx, raw in enumerate(scan.records):
+            try:
+                rec = _load_op(raw, MODEB_OP_SCHEMA)
+            except (ValueError, IndexError) as e:
+                corrupt_c.inc()
+                if newest and idx >= scan.n_synced and not degraded:
+                    tolerated_c.inc()
+                    log.warning("journal %s: dropping undecodable record "
+                                "%d in the unsynced tail (%s)", path, idx, e)
+                    break
+                log.error("journal %s: record %d is fsynced but "
+                          "undecodable (%s): degrading to peer repair",
+                          path, idx, e)
+                degraded = True
+                continue
+            dispatch(rec, idx, scan, newest)
+        if bad:
+            corrupt_c.inc()
+            quarantine_journal(path, scan)
+            degraded = True
+            # the intact suffix past the corrupt gap still holds
+            # externally-sourced records worth keeping (frames, payloads,
+            # adopted checkpoints); the tick stream is unrecoverable
+            for raw in scan.suffix:
                 try:
-                    stage(rec[1])
+                    rec = _load_op(raw, MODEB_OP_SCHEMA)
                 except (ValueError, IndexError):
-                    pass  # tolerate a frame torn by the crash
-            elif op == OP_PAYLOAD:
-                _, rid, pl, stop = rec
-                if rid not in node.outstanding and rid not in node.payloads:
-                    node._store_payload(rid, pl, stop)
-            elif op == OP_TAINT:
-                # a tainted birth must survive the crash: an untainted
-                # recovered row with empty state would serve bad reads AND
-                # donate the empty state to tainted peers (state loss)
-                row = node.rows.row(rec[1])
-                if row is not None:
-                    node._tainted_rows.add(row)
-            elif op == OP_CKPT:
-                _, gid, packet = rec
-                row = node._gid_row.get(gid)
-                if row is not None:
-                    node._apply_ckpt(row, packet)
-            elif op == OP_TICK:
-                _, tick_num, placed, alive_b = rec
-                if tick_num < node.tick_num:
-                    continue  # already inside the snapshot
-                bufs = new_buffers()
-                node._placed = []
-                for row, entries in placed:
-                    take = []
-                    placed_rids = set()
-                    for rid, p, payload, stop in entries:
-                        if rid_origin(rid) == node.r:
-                            node._next_seq = max(
-                                node._next_seq, (rid & RID_MASK) + 1
-                            )
-                        placed_rids.add(rid)
-                        # payload None = digest-only placement (the rid was
-                        # placed before its payload arrived); replay places
-                        # it identically and execution follows the same
-                        # learned-payload / taint path as the live run
-                        if payload is not None and (
-                            rid not in node.outstanding
-                            and rid not in node.payloads
-                        ):
-                            node._store_payload(rid, payload, stop)
-                        place(bufs, p, row, rid, stop)
-                        take.append((rid, p))
-                    node._placed.append((row, take))
-                    # snapshot queues may hold copies of rids whose placement
-                    # is journaled after it — drop or they commit twice
-                    if row in node._queues and placed_rids:
-                        node._queues[row] = collections.deque(
-                            r for r in node._queues[row]
-                            if r not in placed_rids
-                        )
-                node._flush_mirrors()  # frames staged since the last tick
-                out, changed = run_tick(
-                    bufs, np.frombuffer(alive_b, dtype=bool)
-                )
-                node._process_outbox(out)
-                drain = getattr(node, "_drain_stalled", None)
-                if drain is not None:  # digest-mode stalls release as the
-                    drain()            # journaled payload arrivals replay
-                node._dirty |= changed
-                node.tick_num = tick_num + 1
+                    corrupt_c.inc()
+                    continue
+                dispatch(rec, len(scan.records), scan, False)
+    return degraded
 
 
 class ModeBLogger(PaxosLogger):
@@ -147,28 +250,28 @@ class ModeBLogger(PaxosLogger):
         """Journal a replica-universe expansion (node addition): replay
         must re-grow the state arrays before any later record that assumes
         the larger R."""
-        self.journal.append(records.dumps((OP_EXPAND, list(new_ids))))
+        self._append(records.dumps((OP_EXPAND, list(new_ids))))
         self._sync()
 
     def log_frame(self, payload: bytes) -> None:
         """Journal an applied replica frame (before mirror mutation; rides
         the next tick's group commit for fsync)."""
-        self.journal.append(records.dumps((OP_FRAME, payload)))
+        self._append(records.dumps((OP_FRAME, payload)))
 
     def log_taint(self, name: str) -> None:
         """Journal a taint mark (out-of-tick mutation, like log_ckpt)."""
-        self.journal.append(records.dumps((OP_TAINT, name)))
+        self._append(records.dumps((OP_TAINT, name)))
         self._sync()
 
     def log_payload(self, rid: int, payload: bytes, stop: bool) -> None:
         """Journal an out-of-band payload fill (undigest reply): it changes
         what replay can execute, exactly like a frame's payload items."""
-        self.journal.append(records.dumps((OP_PAYLOAD, rid, payload, stop)))
+        self._append(records.dumps((OP_PAYLOAD, rid, payload, stop)))
 
     def log_ckpt(self, gid: int, packet: dict) -> None:
         """Journal an adopted checkpoint transfer — it mutates own-row state
         outside the deterministic tick, so replay must re-apply it."""
-        self.journal.append(records.dumps((OP_CKPT, gid, dict(packet))))
+        self._append(records.dumps((OP_CKPT, gid, dict(packet))))
         self._sync()
 
     def log_inbox(self, tick_num: int, inbox) -> None:
@@ -193,7 +296,7 @@ class ModeBLogger(PaxosLogger):
                 placed.append((row, entries))
         alive = np.asarray(inbox.alive).tobytes()
         rec_bytes = records.dumps((OP_TICK, tick_num, placed, alive))
-        self.journal.append(rec_bytes)
+        self._append(rec_bytes)
         self._append_bytes.inc(len(rec_bytes))
         self._ticks_since_sync += 1
         if self._ticks_since_sync >= self.sync_every:
@@ -245,24 +348,32 @@ class ModeBLogger(PaxosLogger):
 
 
 def recover_modeb(cfg, member_ids, node_id, app, log_dir: str,
-                  native: bool = True, spill_ns=None):
+                  native: bool = True, spill_ns=None,
+                  allow_degraded: bool = True):
     """Rebuild a ModeBNode from its own disk; attach a messenger and call
-    ``request_sync()`` afterwards to rejoin the replica set."""
+    ``request_sync()`` afterwards to rejoin the replica set.
+
+    If replay finds a scribbled journal (see ``replay_node_journals``),
+    the journal is quarantined and — when peers exist and
+    ``allow_degraded`` — every own row is tainted so the laggard-repair
+    machinery re-fetches authoritative state via checkpoint transfer;
+    otherwise recovery fail-stops with :class:`WalQuarantinedError`
+    rather than silently serve a truncated log."""
     import collections
 
     import jax.numpy as jnp
 
     from ..ops.tick import TickInbox
     from ..paxos.state import PaxosState
+    from ..wal.logger import load_latest_snapshot
     from . import wire
     from .manager import ModeBNode, ModeBRecord
 
     logger = ModeBLogger(log_dir, native=native)
-    snap_seq = logger._latest_snapshot_seq()
-    meta = npz_blob = None
-    if snap_seq is not None:
-        with open(logger._snapshot_path(snap_seq), "rb") as f:
-            meta, npz_blob = records.loads(f.read())
+    snap = load_latest_snapshot(log_dir)
+    snap_seq = meta = npz_blob = None
+    if snap is not None:
+        snap_seq, (meta, npz_blob) = snap
     # the universe may have been expanded at runtime (node additions): the
     # snapshot's member list supersedes the boot topology's, and journaled
     # OP_EXPAND records extend it further during replay
@@ -362,13 +473,34 @@ def recover_modeb(cfg, member_ids, node_id, app, log_dir: str,
 
         node._process_outbox = _proc
 
-    replay_node_journals(
+    degraded = replay_node_journals(
         node, log_dir, start_seq,
         stage=lambda raw: node._apply_frame(wire.decode_frame(raw)),
         new_buffers=new_buffers, place=place, run_tick=run_tick,
     )
     if "_process_outbox" in node.__dict__:
         del node._process_outbox
+    if degraded:
+        if not allow_degraded or len(node.members) < 2:
+            raise WalQuarantinedError(
+                f"WAL {log_dir}: scribbled journal quarantined and no peer "
+                "can repair this node (allow_degraded="
+                f"{allow_degraded}, members={list(node.members)}) — "
+                "fail-stop rather than serve a truncated log")
+        # the deterministic tick stream broke at the corruption point, so
+        # every own row may be behind its acked state: taint them ALL and
+        # let the existing laggard-repair machinery (peer checkpoint
+        # transfer + anti-entropy request_sync) restore authority.  Until
+        # repaired, tainted rows neither serve nor donate.
+        for _name, row in node.rows.items():
+            node._tainted_rows.add(row)
+        node.recovered_degraded = True
+        import logging
+
+        logging.getLogger("gptpu.wal").error(
+            "node %s recovered DEGRADED from %s: %d own rows tainted, "
+            "awaiting peer checkpoint repair", node_id, log_dir,
+            len(node._tainted_rows))
 
     node._flush_mirrors()  # frames journaled after the last tick record
     node._held_callbacks = []  # no live clients to answer during replay
@@ -387,5 +519,10 @@ def recover_modeb(cfg, member_ids, node_id, app, log_dir: str,
         node._stall_tick[row] = node.tick_num
     logger.attach(node)
     node.wal = logger
+    if degraded:
+        # persist the blanket taint: a second crash before the peer repair
+        # completes must come back still-tainted, not trusting stale state
+        for name in list(node.rows.names()):
+            logger.log_taint(name)
     node._force_full = True  # re-announce our row to peers on rejoin
     return node

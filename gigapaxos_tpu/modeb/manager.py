@@ -590,6 +590,17 @@ class ModeBNode(ModeBCommon):
         """Lock-free fast path: stage the request for the next tick's drain
         (see paxos/manager.propose — the existence/fenced pre-checks are
         racy reads; the authoritative outcome rides the callback)."""
+        wal = self.wal
+        _aw = getattr(wal, "accepting_writes", None)  # test stubs lack it
+        if _aw is not None and not _aw():
+            # storage low-watermark / failed WAL: shed with the retriable
+            # failure convention (response None); reads keep serving
+            wal.note_shed()
+            self.stats["shed_requests"] += 1
+            with self.lock:
+                if callback is not None:
+                    self._held_callbacks.append((callback, -1, None))
+            return None
         row = self.rows.row(name)  # racy read: benign for the POSITIVE case
         if row is None or row in self._stopped_rows:
             # a racy negative re-checks under the lock before rejecting: a

@@ -116,7 +116,7 @@ class FrameReader:
     the micro-bench; the owner aggregates them into Transport.stats when the
     connection closes."""
 
-    __slots__ = ("sock", "buf", "pos", "syscalls", "frames")
+    __slots__ = ("sock", "buf", "pos", "syscalls", "frames", "peer")
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
@@ -124,6 +124,7 @@ class FrameReader:
         self.pos = 0  # parse cursor: buf[:pos] is consumed
         self.syscalls = 0
         self.frames = 0
+        self.peer = "?"  # set by the accept loop once the hello names it
 
     def _fill(self, need: int) -> bool:
         """Ensure ``need`` unconsumed bytes are buffered; False on EOF."""
@@ -148,6 +149,10 @@ class FrameReader:
             return None
         ln, kind = _HDR.unpack_from(self.buf, self.pos)
         if ln < 1 or ln - 1 > MAX_FRAME:
+            # corrupt length: the drop below is otherwise silent, so make
+            # a flaky NIC / hostile peer countable before severing the link
+            _obs_registry().counter(
+                "transport_corrupt_frames_total", peer=self.peer).inc()
             return None  # corrupt length: drop the connection
         if not self._fill(_HDR.size + ln - 1):
             return None
@@ -458,6 +463,7 @@ class Transport:
                 sender = json.loads(payload.decode()).get("node", "?")
             except (ValueError, AttributeError):
                 return  # bad hello; drop connection
+            reader.peer = sender
             while not self.closed:
                 frame = reader.next_frame()
                 if frame is None:

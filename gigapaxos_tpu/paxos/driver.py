@@ -11,10 +11,25 @@ idea (``gigapaxos/RequestBatcher.java:25-60``) applied to the whole plane.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
+from typing import Callable, Optional
 
+from ..wal.logger import WalError
 from .manager import PaxosManager
+
+log = logging.getLogger(__name__)
+
+#: process-wide hook for unrecoverable storage failures surfacing in a tick
+#: loop (fsyncgate semantics: the kernel may have dropped dirty pages, so
+#: retrying the write would ack data that never reached disk).  The cells
+#: worker installs a handler that dumps the flight recorder and exits the
+#: process nonzero so the supervisor restarts the cell onto intact storage;
+#: in-process embeddings (tests, notebooks) leave it None and observe
+#: ``driver.fatal`` instead — the driver thread stops ticking either way,
+#: which is exactly "the node stops acking".
+FATAL_HANDLER: Optional[Callable[[BaseException], None]] = None
 
 
 class TickDriver:
@@ -30,6 +45,8 @@ class TickDriver:
         self.manager = manager
         self.idle_sleep_s = idle_sleep_s
         self.drain_ticks = drain_ticks
+        #: the WalError that fail-stopped this driver, if any
+        self.fatal: Optional[BaseException] = None
         self._stop = threading.Event()
         self._kick = threading.Event()
         self._first_tick = threading.Event()
@@ -84,7 +101,20 @@ class TickDriver:
                 if gap > 0:
                     time.sleep(gap)  # coalesce: let requests accumulate
                 last = time.monotonic()
-            out = self.manager.tick()
+            try:
+                self.manager.tick()
+            except WalError as e:
+                # fail-stop: storage lost (or refused) a write the plane
+                # was about to ack.  Stop ticking — no further decision is
+                # acked from this node — and surface the failure instead of
+                # dying as a silent daemon thread.
+                self.fatal = e
+                log.critical("tick driver fail-stop (WAL): %s", e)
+                self._first_tick.set()  # unblock wait_ready() callers
+                handler = FATAL_HANDLER
+                if handler is not None:
+                    handler(e)
+                return
             self._first_tick.set()
             # CPython locks are unfair: without a yield window the driver
             # re-acquires manager.lock before any waiting control-plane

@@ -763,6 +763,8 @@ class PaxosManager:
         callback (a request staged for a group that is removed or stops
         before the drain fails with response None, as before).
         """
+        if self.wal is not None and not self.wal.accepting_writes():
+            return self._shed_propose(callback)
         row = self.rows.row(name)  # racy read: benign (see docstring)
         if row is None:
             if name in self._paused:
@@ -779,6 +781,20 @@ class PaxosManager:
         if self.reqtrace.enabled:
             self.reqtrace.event(rid, "staged", name=name)
         return rid
+
+    @_locked
+    def _shed_propose(self, callback):
+        """Storage low-watermark / failed WAL: refuse new writes with the
+        retriable failure convention (response None) while reads and the
+        already-admitted pipeline keep serving.  The disk-full case clears
+        itself once the GC or an operator frees space; the failed case
+        fail-stops the node at the next tick anyway."""
+        self.wal.note_shed()
+        if callback is not None:
+            self._held_callbacks.append((callback, -1, None))
+        self.stats["shed_requests"] += 1
+        self.stats["failed_requests"] += 1
+        return None
 
     @_locked
     def _propose_locked(self, name, payload, callback, stop, entry):
@@ -890,6 +906,15 @@ class PaxosManager:
                 "device-app managers admit bulk work via propose_bulk_kv "
                 "(a plain payload has no descriptor and could never place)"
             )
+        if self.wal is not None and not self.wal.accepting_writes():
+            # storage low-watermark / failed WAL: whole batch sheds with
+            # the transient-backpressure code (-2, plain retry) — same
+            # contract as a full store window; no callback fires
+            self.wal.note_shed()
+            n = len(rows)
+            self.stats["shed_requests"] += n
+            self.stats["failed_requests"] += n
+            return np.full(n, -2, np.int64)
         store = self._ensure_bulk()
         rows = np.asarray(rows, np.int64)
         out = np.full(len(rows), -1, np.int64)

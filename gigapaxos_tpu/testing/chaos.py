@@ -37,8 +37,14 @@ SIM_ACTIONS = frozenset({
     "crash", "recover", "partition", "heal", "slow_node", "fsync_stall",
     "cut_region", "heal_region", "set_delay", "drop_pending",
     "mark_down", "mark_up", "propose",
+    # storage faults (testing/faultdisk.py): the first two operate on a
+    # CRASHED node's journal files; the last three arm the live shim
+    "bit_flip", "torn_write", "fsync_error", "disk_full", "disk_ok",
 })
-PROC_ACTIONS = frozenset({"crash", "recover", "fsync_stall", "propose"})
+PROC_ACTIONS = frozenset({
+    "crash", "recover", "fsync_stall", "propose",
+    "bit_flip", "torn_write", "fsync_error", "disk_full",
+})
 
 
 @dataclasses.dataclass(frozen=True)
@@ -170,7 +176,18 @@ class SimChaosRunner:
 
     def __init__(self, net, nodes: Mapping[str, object],
                  schedule: ChaosSchedule,
-                 ledger: Optional[SafetyLedger] = None):
+                 ledger: Optional[SafetyLedger] = None,
+                 wal_dirs: Optional[Mapping[str, str]] = None,
+                 injector=None,
+                 restart: Optional[Callable[[str], object]] = None,
+                 rng=None):
+        """Storage-fault extras (all optional — pure network chaos needs
+        none of them): ``wal_dirs`` maps node id -> WAL directory,
+        ``injector`` is a ``faultdisk.Injector`` whose shims wrap the
+        nodes' journals, ``restart(node_id) -> node`` rebuilds a crashed
+        node from its (possibly damaged) WAL dir — real recovery instead
+        of the perfect in-memory restore.  ``rng`` seeds bit_flip/
+        torn_write placement."""
         schedule.validate(SIM_ACTIONS)
         self.net = net
         self.nodes = dict(nodes)
@@ -186,6 +203,11 @@ class SimChaosRunner:
         self.stalled: Dict[str, int] = {}  # node -> remaining stalled ticks
         self.tick = 0
         self.proposals: List[dict] = []  # completions from 'propose' events
+        self.wal_dirs = dict(wal_dirs or {})
+        self.injector = injector
+        self.restart = restart
+        self.rng = rng
+        self.failstops: List[dict] = []  # nodes that died on their disk
 
     # ------------------------------------------------------------- actions
     def _isolate(self, node: str) -> None:
@@ -221,6 +243,33 @@ class SimChaosRunner:
                 self._pending.sort(key=lambda e: (e.at_tick, e.action))
         elif a == "recover":
             node = args["node"]
+            if self.restart is not None and node not in self.crashed:
+                # the fault this recover was scheduled for never tripped
+                # (e.g. an armed fsync_error with no traffic): replacing a
+                # LIVE node with a disk image would itself lose state
+                info["skipped"] = "node not down"
+                self.log.record(ev.at_tick, a, args, **info)
+                return
+            if self.restart is not None:
+                # real recovery: rebuild from the WAL dir, which chaos may
+                # have damaged since the crash.  A quarantined-beyond-
+                # repair log fail-stops right here — the node stays down,
+                # which is the contract (never serve from doubted state).
+                from ..wal.logger import WalError
+
+                try:
+                    fresh = self.restart(node)
+                except WalError as e:
+                    info["failstop"] = f"{type(e).__name__}: {e}"
+                    self.failstops.append(
+                        {"tick": self.tick, "node": node, "where": "recover",
+                         "error": str(e)})
+                    self.log.record(ev.at_tick, a, args, **info)
+                    return
+                self.nodes[node] = fresh
+                self.ledger.attach(node, fresh)
+                info["recovered_degraded"] = bool(
+                    getattr(fresh, "recovered_degraded", False))
             self.crashed.discard(node)
             self._reconnect(node)
             self._mark(node, True)
@@ -255,6 +304,60 @@ class SimChaosRunner:
             self._mark(args["node"], False)
         elif a == "mark_up":
             self._mark(args["node"], True)
+        elif a == "bit_flip":
+            # damage a CRASHED node's newest journal on disk — what a bad
+            # disk does while the process is gone
+            from . import faultdisk
+
+            node = args["node"]
+            path = args.get("path") or faultdisk.newest_journal(
+                self.wal_dirs[node])
+            if path is None:
+                info["skipped"] = "no journal"
+            else:
+                info["offset"] = faultdisk.flip_byte(path, args.get("offset"),
+                                                     rng=self.rng)
+                info["path"] = path
+        elif a == "torn_write":
+            node = args["node"]
+            if node in self.crashed:
+                # post-crash view: truncate the dead node's newest journal
+                from . import faultdisk
+
+                path = args.get("path") or faultdisk.newest_journal(
+                    self.wal_dirs[node])
+                if path is None:
+                    info["skipped"] = "no journal"
+                else:
+                    info["dropped"] = faultdisk.tear_tail(
+                        path, args.get("drop_bytes"), rng=self.rng)
+                    info["path"] = path
+            else:
+                # live shim: the next append tears mid-frame and the tick
+                # loop fail-stops the node
+                info["armed"] = bool(self.injector and self.injector.arm(
+                    self.wal_dirs[node], "torn_write"))
+        elif a == "fsync_error":
+            node = args["node"]
+            info["armed"] = bool(self.injector and self.injector.arm(
+                self.wal_dirs[node], "fsync_error"))
+        elif a == "disk_full":
+            node = args["node"]
+            if args.get("hard"):
+                # actual ENOSPC from the write path: fail-stop territory
+                info["armed"] = bool(self.injector and self.injector.arm(
+                    self.wal_dirs[node], "disk_full"))
+            else:
+                # low-watermark breach: the node sheds new proposals with a
+                # retriable error but keeps serving reads and acked work
+                self.nodes[node].wal.shedding = True
+        elif a == "disk_ok":
+            node = args["node"]
+            nd = self.nodes[node]
+            if getattr(nd, "wal", None) is not None:
+                nd.wal.shedding = False
+            if self.injector is not None:
+                self.injector.clear(self.wal_dirs[node], "disk_full")
         elif a == "propose":
             node, name = args["node"], args["group"]
             payload = str(args["payload"]).encode()
@@ -278,6 +381,8 @@ class SimChaosRunner:
         """Advance ``ticks`` ticks, applying due events before each one.
         ``on_tick(t)`` (if given) runs after each tick+pump — the hook the
         geo soak uses to timestamp commits."""
+        from ..wal.logger import WalError
+
         for _ in range(ticks):
             while self._pending and self._pending[0].at_tick <= self.tick:
                 self._apply(self._pending.pop(0))
@@ -291,7 +396,20 @@ class SimChaosRunner:
                     else:
                         self.stalled[nid] = left - 1
                     continue  # tick thread blocked in fsync
-                nd.tick()
+                try:
+                    nd.tick()
+                except WalError as e:
+                    # storage fail-stop: the node stops acking and leaves
+                    # the cluster, exactly like a crash — except the event
+                    # is logged as its own kind for the soak's accounting
+                    self.crashed.add(nid)
+                    self._isolate(nid)
+                    self._mark(nid, False)
+                    self.failstops.append(
+                        {"tick": self.tick, "node": nid, "where": "tick",
+                         "error": f"{type(e).__name__}: {e}"})
+                    self.log.record(self.tick, "failstop", {"node": nid},
+                                    error=str(e))
             self.net.pump()
             if on_tick is not None:
                 on_tick(self.tick)
@@ -317,12 +435,22 @@ class ProcChaosRunner:
 
     def __init__(self, procs: Dict[str, object], schedule: ChaosSchedule,
                  restart: Optional[Callable[[str], object]] = None,
-                 tick_s: float = 0.05):
+                 tick_s: float = 0.05,
+                 wal_dirs: Optional[Mapping[str, str]] = None,
+                 rng=None):
+        """``wal_dirs`` (node id -> WAL directory) enables the storage
+        actions: bit_flip / torn_write damage a killed worker's journal
+        files directly; fsync_error / disk_full drop a ``FAULT.json`` plan
+        the worker's journals pick up on their next (re)open — the worker
+        must run with ``GPTPU_WAL_FAULTS=1`` for the plan to take effect
+        (see testing/faultdisk.wrap_from_env)."""
         schedule.validate(PROC_ACTIONS)
         self.procs = procs
         self.schedule = schedule
         self.restart = restart
         self.tick_s = tick_s
+        self.wal_dirs = dict(wal_dirs or {})
+        self.rng = rng
         self.log = ChaosLog(schedule)
         self._stopped: Dict[str, float] = {}  # node -> resume deadline
 
@@ -347,6 +475,34 @@ class ProcChaosRunner:
             self.procs[node].proc.send_signal(signal.SIGSTOP)
             self._stopped[node] = (time.monotonic()
                                    + int(args.get("ticks", 1)) * self.tick_s)
+        elif a == "bit_flip":
+            from . import faultdisk
+
+            path = args.get("path") or faultdisk.newest_journal(
+                self.wal_dirs[args["node"]])
+            if path is None:
+                info["skipped"] = "no journal"
+            else:
+                info["offset"] = faultdisk.flip_byte(path, args.get("offset"),
+                                                     rng=self.rng)
+                info["path"] = path
+        elif a == "torn_write":
+            from . import faultdisk
+
+            path = args.get("path") or faultdisk.newest_journal(
+                self.wal_dirs[args["node"]])
+            if path is None:
+                info["skipped"] = "no journal"
+            else:
+                info["dropped"] = faultdisk.tear_tail(
+                    path, args.get("drop_bytes"), rng=self.rng)
+                info["path"] = path
+        elif a in ("fsync_error", "disk_full"):
+            from . import faultdisk
+
+            info["plan"] = faultdisk.write_plan(
+                self.wal_dirs[args["node"]],
+                {f"{a}_after": int(args.get("after", 0))})
         elif a == "propose":
             h = self.procs[args["node"]]
             h.send(f"propose {args['group']} "

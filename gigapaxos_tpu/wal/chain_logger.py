@@ -15,9 +15,7 @@ import io
 
 import numpy as np
 
-from . import records
-
-from .logger import PaxosLogger, replay_journals
+from .logger import PaxosLogger, load_latest_snapshot, replay_journals
 
 
 class ChainLogger(PaxosLogger):
@@ -54,11 +52,10 @@ def recover_chain(cfg, n_replicas: int, apps, log_dir: str, native: bool = True)
 
     logger = ChainLogger(log_dir, native=native)
     m = ChainManager(cfg, n_replicas, apps)
-    snap_seq = logger._latest_snapshot_seq()
+    snap = load_latest_snapshot(log_dir)
     start_seq = 0
-    if snap_seq is not None:
-        with open(logger._snapshot_path(snap_seq), "rb") as f:
-            meta, npz_blob = records.loads(f.read())
+    if snap is not None:
+        snap_seq, (meta, npz_blob) = snap
         arrs = np.load(io.BytesIO(npz_blob))
         m.state = ChainState(
             **{f: jnp.asarray(arrs[f]) for f in ChainState._fields}

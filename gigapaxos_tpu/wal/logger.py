@@ -29,17 +29,131 @@ from __future__ import annotations
 import glob
 import io
 import os
+import struct
 import time
+import zlib
 from typing import List, Optional
 
 import numpy as np
 
 from . import records
+from .journal import JournalCorruptError, scan_journal
 from ..obs.metrics import registry as _obs_registry
 from ..paxos.state import PaxosState
 
 #: fsyncs slower than this count as stalls (the cloud-variance signal).
 FSYNC_STALL_S = float(os.environ.get("GPTPU_FSYNC_STALL_MS", "10")) / 1e3
+
+#: snapshot generations kept before GC (corrupt-latest falls back one
+#: generation at the cost of a longer replay)
+SNAPSHOT_KEEP = int(os.environ.get("GPTPU_SNAPSHOT_KEEP", "2"))
+#: free-bytes low watermark: below it the WAL sheds NEW writes with a
+#: retriable error instead of running the disk to ENOSPC mid-fsync
+#: (0 disables the check)
+MIN_FREE_BYTES = int(os.environ.get("GPTPU_WAL_MIN_FREE_BYTES", "0"))
+_FREE_CHECK_EVERY = 32  # statvfs on every Nth fsync, not every one
+
+SNAP_MAGIC = b"GPTPUS01"
+_SNAP_FTR = struct.Struct("<II")  # crc32(blob), len(blob); then SNAP_MAGIC
+
+
+class WalError(RuntimeError):
+    """Base for storage-fault conditions the WAL surfaces loudly."""
+
+
+class WalFailedError(WalError):
+    """append/fsync raised OSError: the journal is failed and the node
+    must stop acking (fsyncgate: a post-error retry may 'succeed' while
+    the dirty pages were already dropped — fail-stop is the only sound
+    response)."""
+
+
+class WalQuarantinedError(WalError):
+    """Recovery found a scribble it cannot repair locally (no peer copy
+    of this WAL exists): fail-stop rather than silently serve a
+    truncated log."""
+
+
+class SnapshotCorruptError(WalError):
+    """Snapshot blob failed its CRC/length footer check."""
+
+
+def write_snapshot(path: str, blob: bytes) -> None:
+    """Atomic snapshot write: blob + CRC/length footer, fsynced tmp,
+    rename.  The footer makes a damaged snapshot *detectable* so recovery
+    can fall back a generation instead of loading garbage state."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.write(_SNAP_FTR.pack(zlib.crc32(blob), len(blob)))
+        f.write(SNAP_MAGIC)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_snapshot_blob(path: str) -> bytes:
+    """Read + verify a snapshot blob.  Footer-less files (pre-format-bump
+    snapshots) are returned as-is for compatibility — their corruption is
+    still usually caught by the records codec, just less crisply."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    ftr = len(SNAP_MAGIC) + _SNAP_FTR.size
+    if len(raw) >= ftr and raw[-len(SNAP_MAGIC):] == SNAP_MAGIC:
+        crc, ln = _SNAP_FTR.unpack(raw[-ftr:-len(SNAP_MAGIC)])
+        blob = raw[:-ftr]
+        if ln != len(blob) or zlib.crc32(blob) != crc:
+            raise SnapshotCorruptError(
+                f"snapshot {path}: footer mismatch "
+                f"(len {len(blob)} vs {ln})")
+        return blob
+    return raw
+
+
+def load_latest_snapshot(log_dir: str):
+    """Newest loadable snapshot as ``(seq, decoded)`` or ``None``.
+
+    A snapshot that fails its checksum (or decode) is renamed aside to
+    ``*.corrupt`` and the previous generation is tried — the generational
+    GC in :meth:`PaxosLogger._gc` keeps SNAPSHOT_KEEP of them around for
+    exactly this fallback, trading disk for a longer journal replay."""
+    snaps = sorted(glob.glob(os.path.join(log_dir, "snapshot.*.bin")),
+                   reverse=True)
+    for path in snaps:
+        try:
+            decoded = records.loads(read_snapshot_blob(path))
+        except (WalError, ValueError, OSError) as e:
+            _obs_registry().counter(
+                "snapshot_fallbacks_total",
+                help="corrupt snapshots skipped at recovery",
+            ).inc()
+            os.replace(path, path + ".corrupt")
+            import logging
+
+            logging.getLogger("gptpu.wal").error(
+                "snapshot %s corrupt (%s); falling back a generation",
+                path, e)
+            continue
+        return int(os.path.basename(path).split(".")[1]), decoded
+    return None
+
+
+def quarantine_journal(path: str, scan=None) -> str:
+    """Move a scribbled journal aside (``*.quarantined``) so it is out of
+    the replay glob but preserved for forensics/repair, and count it."""
+    dst = path + ".quarantined"
+    os.replace(path, dst)
+    _obs_registry().counter(
+        "wal_quarantines_total",
+        help="journals quarantined for mid-log corruption",
+    ).inc()
+    import logging
+
+    logging.getLogger("gptpu.wal").error(
+        "quarantined scribbled journal %s -> %s%s", path, dst,
+        f" (corrupt at byte {scan.bad_offset}, {len(scan.suffix)} intact "
+        f"records after the damage)" if scan is not None else "")
+    return dst
 
 OP_CREATE = 1
 OP_REMOVE = 2
@@ -53,22 +167,50 @@ OP_CREATE_AT = 7  # targeted create (placement migration): carries the row
                   # exists nowhere else once the source epoch is dropped
 
 
+#: test-only hook: the storage fault-injection plane wraps every journal
+#: the loggers open (testing/faultdisk.py); None in production
+_JOURNAL_WRAP = None
+
+
+def set_journal_wrapper(fn) -> None:
+    global _JOURNAL_WRAP
+    _JOURNAL_WRAP = fn
+
+
 def _new_journal(path: str, native_ok: bool):
+    j = None
     if native_ok:
         try:
             from .native_journal import NativeJournal
 
-            return NativeJournal(path)
+            j = NativeJournal(path)
+        except JournalCorruptError:
+            # scribble: PyJournal would refuse identically — surface it,
+            # the silent-fallback path is for missing toolchains only
+            raise
         except Exception:
             pass
-    from .journal import PyJournal
+    if j is None:
+        from .journal import PyJournal
 
-    return PyJournal(path)
+        j = PyJournal(path)
+    if _JOURNAL_WRAP is not None:
+        j = _JOURNAL_WRAP(j, path)
+    elif os.environ.get("GPTPU_WAL_FAULTS"):
+        # cross-process injection (ProcChaosRunner workers): the plan file
+        # lives next to the journal so the runner can arm faults in a
+        # child it cannot reach in-process
+        from ..testing.faultdisk import wrap_from_env
+
+        j = wrap_from_env(j, path)
+    return j
 
 
 class PaxosLogger:
     def __init__(self, log_dir: str, sync_every_ticks: int = 1,
-                 checkpoint_every_ticks: int = 1024, native: bool = True):
+                 checkpoint_every_ticks: int = 1024, native: bool = True,
+                 snapshot_keep: int = SNAPSHOT_KEEP,
+                 min_free_bytes: int = MIN_FREE_BYTES):
         self.dir = log_dir
         os.makedirs(log_dir, exist_ok=True)
         self.sync_every = max(1, sync_every_ticks)
@@ -79,6 +221,14 @@ class PaxosLogger:
         self.journal = None
         self._ticks_since_sync = 0
         self._ticks_since_ckpt = 0
+        self.snapshot_keep = max(1, snapshot_keep)
+        self.min_free_bytes = max(0, min_free_bytes)
+        #: append/fsync raised OSError: sticky — the node must fail-stop
+        self.failed = False
+        #: free-space low watermark tripped: shed NEW writes (retriable),
+        #: keep serving reads; clears with hysteresis once space returns
+        self.shedding = False
+        self._syncs_since_free_check = 0
         # fsync observability: every durability point goes through _sync()
         # (tests/test_obs_coverage.py asserts no bare journal.sync() calls)
         self._fsync_h = _obs_registry().histogram(
@@ -88,23 +238,100 @@ class PaxosLogger:
             help=f"fsyncs slower than {FSYNC_STALL_S * 1e3:.0f}ms")
         self._append_bytes = _obs_registry().counter(
             "wal_appended_bytes_total", help="journaled tick-record bytes")
+        self._failstops = _obs_registry().counter(
+            "wal_failstops_total",
+            help="journals marked failed after an append/fsync OSError")
+        self._disk_full_g = _obs_registry().gauge(
+            "wal_disk_full",
+            help="1 while the free-bytes low watermark is shedding writes")
+        self._shed_writes = _obs_registry().counter(
+            "wal_shed_writes_total",
+            help="proposals shed (retriable) while below the watermark")
+
+    # ---------------------------------------------------------- fault surface
+    def accepting_writes(self) -> bool:
+        """False once the WAL can no longer make new writes durable —
+        failed (fail-stop) or below the disk-full watermark (shed with a
+        retriable error; reads keep serving)."""
+        return not (self.failed or self.shedding)
+
+    def note_shed(self) -> None:
+        self._shed_writes.inc()
+
+    def _fail(self, exc: OSError) -> None:
+        """fsyncgate discipline: after ANY append/fsync OSError the kernel
+        may have dropped the dirty pages, so retrying could ack data that
+        never hit disk.  Mark the journal failed (sticky) and fail-stop;
+        in cells mode the supervisor restarts the worker, whose recovery
+        re-reads only what the disk actually holds."""
+        self.failed = True
+        self._failstops.inc()
+        import logging
+
+        logging.getLogger("gptpu.wal").critical(
+            "WAL %s failed (%s): fail-stop — no further acks", self.dir, exc)
+        raise WalFailedError(
+            f"WAL {self.dir} append/fsync failed: {exc}") from exc
+
+    def _append(self, rec: bytes) -> None:
+        try:
+            self.journal.append(rec)
+        except OSError as e:
+            self._fail(e)
+
+    def _check_free_space(self) -> None:
+        if self.min_free_bytes <= 0:
+            return
+        self._syncs_since_free_check += 1
+        if self._syncs_since_free_check < _FREE_CHECK_EVERY and \
+                not self.shedding:
+            return
+        self._syncs_since_free_check = 0
+        try:
+            st = os.statvfs(self.dir)
+        except OSError:
+            return
+        avail = st.f_bavail * st.f_frsize
+        if not self.shedding and avail < self.min_free_bytes:
+            self.shedding = True
+            self._disk_full_g.set(1)
+            import logging
+
+            logging.getLogger("gptpu.wal").error(
+                "WAL %s below free-space watermark (%d < %d bytes): "
+                "shedding new writes (retriable)", self.dir, avail,
+                self.min_free_bytes)
+        elif self.shedding and avail >= 2 * self.min_free_bytes:
+            # 2x hysteresis so the gauge does not flap at the boundary
+            self.shedding = False
+            self._disk_full_g.set(0)
 
     def _sync(self) -> None:
         """The single durability point: fsync the journal, timed.  Slow
         fsyncs (> FSYNC_STALL_S) are the cloud-variance signal the paper
-        says dominates tails, so they get their own counter."""
+        says dominates tails, so they get their own counter.  An OSError
+        here is fail-stop (see _fail)."""
         t0 = time.perf_counter()
-        self.journal.sync()
+        try:
+            self.journal.sync()
+        except OSError as e:
+            self._fail(e)
         dt = time.perf_counter() - t0
         self._fsync_h.observe(dt)
         if dt >= FSYNC_STALL_S:
             self._fsync_stalls.inc()
+        self._check_free_space()
 
     # ------------------------------------------------------------------ wiring
     def attach(self, manager) -> None:
         self.manager = manager
         if self.journal is None:
-            self.seq = self._latest_snapshot_seq() or 0
+            # continue the NEWEST journal, which after a corrupt-snapshot
+            # generation fallback is newer than the newest loadable
+            # snapshot — appending to an older file would scramble the
+            # replay order of the next recovery
+            self.seq = max(journal_seqs(self.dir)
+                           + [self._latest_snapshot_seq() or 0])
             self.journal = _new_journal(self._journal_path(self.seq), self.native)
 
     def _journal_path(self, seq: int) -> str:
@@ -121,14 +348,14 @@ class PaxosLogger:
 
     # ----------------------------------------------------------------- logging
     def log_create(self, name: str, members: List[int], epoch: int) -> None:
-        self.journal.append(records.dumps((OP_CREATE, name, members, epoch)))
+        self._append(records.dumps((OP_CREATE, name, members, epoch)))
         self._sync()
 
     def log_creates(self, names, members: List[int], epoch: int) -> None:
         """Batched create logging: individual OP_CREATE records (replay is
         unchanged), ONE group-commit fsync."""
         for name in names:
-            self.journal.append(
+            self._append(
                 records.dumps((OP_CREATE, name, list(members), epoch))
             )
         self._sync()
@@ -140,23 +367,23 @@ class PaxosLogger:
         the free-list in lockstep — and the app seed blob, which for a
         migrated group is the ONLY durable copy of its pre-move history
         once the source epoch's row is removed."""
-        self.journal.append(records.dumps(
+        self._append(records.dumps(
             (OP_CREATE_AT, name, members, epoch, row, app_seed)
         ))
         self._sync()
 
     def log_remove(self, name: str) -> None:
-        self.journal.append(records.dumps((OP_REMOVE, name)))
+        self._append(records.dumps((OP_REMOVE, name)))
         self._sync()
 
     def log_pause(self, names) -> None:
         """Pause/unpause change row allocation, and journaled tick records
         address groups BY ROW — replay must re-apply the same spills in the
         same order or placements would land on the wrong groups."""
-        self.journal.append(records.dumps((OP_PAUSE, list(names))))
+        self._append(records.dumps((OP_PAUSE, list(names))))
 
     def log_unpause(self, name: str) -> None:
-        self.journal.append(records.dumps((OP_UNPAUSE, name)))
+        self._append(records.dumps((OP_UNPAUSE, name)))
 
     def log_sync(self, r: int, name: str, donor: int, donor_exec: int,
                  donor_status: int, ckpt: bytes) -> None:
@@ -172,7 +399,7 @@ class PaxosLogger:
         host scan (sync_laggard) journal byte-identical OP_SYNC records
         for the same repair, and replay applies either verbatim — a crash
         run under one selector replays correctly under the other."""
-        self.journal.append(records.dumps(
+        self._append(records.dumps(
             (OP_SYNC, r, name, donor, donor_exec, donor_status, ckpt)
         ))
 
@@ -214,7 +441,7 @@ class PaxosLogger:
             m._kv_uploaded = None
         rec_bytes = records.dumps((OP_TICK, tick_num, placed_with_payloads,
                                    alive, bulk, kv_reg))
-        self.journal.append(rec_bytes)
+        self._append(rec_bytes)
         self._append_bytes.inc(len(rec_bytes))
         self._ticks_since_sync += 1
         if self._ticks_since_sync >= self.sync_every:
@@ -326,14 +553,12 @@ class PaxosLogger:
         buf = io.BytesIO()
         np.savez_compressed(buf, **state_np)
         blob = records.dumps((meta, buf.getvalue()))
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-        # roll journal
-        self.journal.close()
+        try:
+            write_snapshot(path, blob)
+            # roll journal
+            self.journal.close()
+        except OSError as e:
+            self._fail(e)
         self.seq = new_seq
         self.journal = _new_journal(self._journal_path(new_seq), self.native)
         self._gc(new_seq)
@@ -343,20 +568,125 @@ class PaxosLogger:
         return path
 
     def _gc(self, keep_seq: int) -> None:
-        for f in glob.glob(os.path.join(self.dir, "snapshot.*.bin")) + glob.glob(
-            os.path.join(self.dir, "journal.*.log")
-        ):
-            seq = int(os.path.basename(f).split(".")[1])
-            if seq < keep_seq:
+        """Generational GC: keep the newest ``snapshot_keep`` snapshots
+        (so a corrupt latest can fall back a generation) and every journal
+        a replay from the OLDEST kept snapshot would need."""
+        snap_seqs = sorted(
+            int(os.path.basename(f).split(".")[1])
+            for f in glob.glob(os.path.join(self.dir, "snapshot.*.bin"))
+        )
+        kept = set(snap_seqs[-self.snapshot_keep:]) | {keep_seq}
+        oldest_kept = min(kept)
+        for f in glob.glob(os.path.join(self.dir, "snapshot.*.bin")):
+            if int(os.path.basename(f).split(".")[1]) not in kept:
+                os.remove(f)
+        for f in glob.glob(os.path.join(self.dir, "journal.*.log")):
+            if int(os.path.basename(f).split(".")[1]) < oldest_kept:
                 os.remove(f)
 
     def close(self) -> None:
         if self.journal is not None:
-            self.journal.close()
+            try:
+                self.journal.close()
+            except OSError:
+                # a failed journal may refuse its final sync; the node is
+                # fail-stopping anyway — never mask the original error
+                pass
             self.journal = None
 
 
 # ------------------------------------------------------------------ recovery
+#: op byte -> (min_arity, max_arity) whitelist for Mode A / chain replay:
+#: a corrupt-but-CRC-valid record must fail closed before any dispatcher
+#: indexes into it (wal/records.py docstring warning, made real)
+OP_SCHEMA = {
+    OP_CREATE: (4, 4),
+    OP_REMOVE: (2, 2),
+    OP_TICK: (4, 6),       # legacy records lack bulk/kv_reg fields
+    OP_PAUSE: (2, 2),
+    OP_UNPAUSE: (2, 2),
+    OP_SYNC: (4, 7),       # legacy donor-only records have arity 4
+    OP_CREATE_AT: (6, 6),
+}
+
+
+def journal_seqs(log_dir: str) -> List[int]:
+    return sorted(
+        int(os.path.basename(p).split(".")[1])
+        for p in glob.glob(os.path.join(log_dir, "journal.*.log"))
+    )
+
+
+def _load_op(raw: bytes, schema):
+    """Decode + whitelist-validate one journal record."""
+    rec = records.loads(raw)
+    records.validate_op_record(rec, schema)
+    return rec
+
+
+def _scan_for_replay(path: str, newest: bool):
+    """Scan a journal for replay; scribbles fail-stop here (Mode A and
+    chain WALs have no peer copy, so the intact suffix is unrecoverable
+    locally — the one honest option is to refuse, loudly, with the file
+    left in place as evidence).  Mode B overrides this policy in
+    modeb/logger.py with quarantine + taint + peer repair."""
+    scan = scan_journal(path)
+    if scan.kind == "scribble":
+        _obs_registry().counter(
+            "wal_corrupt_records_total",
+            help="corrupt journal records/regions found at recovery",
+        ).inc()
+        raise WalQuarantinedError(
+            f"journal {path}: mid-log corruption at byte "
+            f"{scan.bad_offset} with {len(scan.suffix)} intact records "
+            "after it — fsynced (possibly acked) data was damaged and "
+            "this WAL has no peer copy to repair from; refusing to "
+            "silently truncate.  The file is left in place; inspect or "
+            "restore it, or move it aside to accept the data loss.")
+    if scan.kind == "torn_tail" and not newest and scan.file_size and \
+            scan.good_len < scan.file_size:
+        # a tear is only innocent in the journal being appended at crash
+        # time; a rolled (older) journal was closed with a final barrier,
+        # so bytes missing from it are lost fsynced data
+        _obs_registry().counter(
+            "wal_corrupt_records_total",
+            help="corrupt journal records/regions found at recovery",
+        ).inc()
+        raise WalQuarantinedError(
+            f"journal {path}: truncated/corrupt tail in a non-newest "
+            f"journal (intact to byte {scan.good_len} of "
+            f"{scan.file_size}) — rolled journals are sealed by their "
+            "final fsync barrier, so this is lost fsynced data, not a "
+            "crash tear.")
+    return scan
+
+
+def _tolerate_or_raise(path: str, idx: int, scan, newest: bool, exc) -> bool:
+    """Shared record-decode failure policy: a CRC-valid record that fails
+    decode/whitelist is tolerable ONLY in the unsynced tail of the newest
+    journal (idx >= n_synced: past the last fsync barrier, so it was
+    never acked).  Returns True to stop replaying this journal."""
+    _obs_registry().counter(
+        "wal_corrupt_records_total",
+        help="corrupt journal records/regions found at recovery",
+    ).inc()
+    if newest and idx >= scan.n_synced:
+        _obs_registry().counter(
+            "wal_replay_tolerated_frames_total",
+            help="undecodable records tolerated in the unsynced tail",
+        ).inc()
+        import logging
+
+        logging.getLogger("gptpu.wal").warning(
+            "journal %s: dropping undecodable record %d in the unsynced "
+            "tail (%s)", path, idx, exc)
+        return True
+    raise WalQuarantinedError(
+        f"journal {path}: record {idx} is CRC-valid but undecodable "
+        f"({exc}) and lies in the fsynced region — corrupt acked data; "
+        "refusing to silently skip it.") from exc
+
+
 def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
                     build_inbox, tick_fn, bulk_replay=None):
     """Shared journal-replay loop (passes 2–3 of recovery) for any manager.
@@ -371,14 +701,19 @@ def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
     """
     import collections
 
-    from .journal import read_journal
-
-    for path in sorted(glob.glob(os.path.join(log_dir, "journal.*.log"))):
+    paths = sorted(glob.glob(os.path.join(log_dir, "journal.*.log")))
+    for path in paths:
         seq = int(os.path.basename(path).split(".")[1])
         if seq < start_seq:
             continue
-        for raw in read_journal(path):
-            rec = records.loads(raw)
+        newest = path == paths[-1]
+        scan = _scan_for_replay(path, newest)
+        for idx, raw in enumerate(scan.records):
+            try:
+                rec = _load_op(raw, OP_SCHEMA)
+            except (ValueError, IndexError) as e:
+                if _tolerate_or_raise(path, idx, scan, newest, e):
+                    break
             op = rec[0]
             if op == OP_CREATE:
                 _, name, members, epoch = rec
@@ -471,7 +806,6 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
 
     from ..paxos.manager import PaxosManager, RequestRecord
     from ..ops.tick import TickInbox, paxos_tick_packed, unpack_outbox
-    from .journal import read_journal
 
     logger = PaxosLogger(log_dir, native=native)
     m = PaxosManager(cfg, n_replicas, apps, spill_ns=spill_ns)
@@ -479,11 +813,10 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
     # they would make OP_CREATE replay return False and desync the row
     # allocation from the original run (snapshot/journal are the authority)
     m._paused.clear()
-    snap_seq = logger._latest_snapshot_seq()
+    snap = load_latest_snapshot(log_dir)
     start_seq = 0
-    if snap_seq is not None:
-        with open(logger._snapshot_path(snap_seq), "rb") as f:
-            meta, npz_blob = records.loads(f.read())
+    if snap is not None:
+        snap_seq, (meta, npz_blob) = snap
         arrs = np.load(io.BytesIO(npz_blob))
         m.state = PaxosState(**{f: jnp.asarray(arrs[f]) for f in PaxosState._fields})
         # checkpoints are taken pipeline-drained (host == device), so the

@@ -69,17 +69,33 @@ class NativeJournal:
     def __init__(self, path: str):
         lib = _load()
         self._lib = lib
+        if os.path.exists(path) and os.path.getsize(path) > 0:
+            # scribble classification is the Python scanner's job: gpj_open
+            # truncates at the first bad frame, which on a mid-log scribble
+            # would silently destroy the intact (possibly acked) suffix
+            from .journal import JournalCorruptError, scan_journal
+
+            scan = scan_journal(path)
+            if scan.kind == "scribble":
+                raise JournalCorruptError(path, scan)
         self._h = lib.gpj_open(path.encode())
         if not self._h:
             raise OSError(f"gpj_open failed for {path}")
         self.path = path
+        self.failed = False
 
     def append(self, record: bytes) -> None:
+        if self.failed:
+            raise OSError("journal has failed; refusing further appends")
         if self._lib.gpj_append(self._h, record, len(record)) != 0:
+            self.failed = True
             raise OSError("journal append failed")
 
     def sync(self) -> None:
+        if self.failed:
+            raise OSError("journal has failed; refusing further syncs")
         if self._lib.gpj_sync(self._h) != 0:
+            self.failed = True
             raise OSError("journal sync failed")
 
     def close(self) -> None:

@@ -162,3 +162,33 @@ def loads(b: bytes):
     if r.o != len(b):
         raise ValueError("records codec: trailing garbage")
     return v
+
+
+class SchemaError(ValueError):
+    """A CRC-valid record decoded to something no logger ever writes."""
+
+
+def validate_op_record(rec, schema) -> int:
+    """Fail-closed whitelist check for op records decoded from disk.
+
+    CRC catches torn/flipped bytes, but a corrupt-but-CRC-valid record (or
+    a record from a foreign/garbage file resynced into the stream) must
+    not reach the replay dispatchers, which index into it and execute it.
+    ``schema`` maps op byte -> (min_arity, max_arity); anything outside
+    the whitelist raises :class:`SchemaError` before any field is used.
+    Returns the validated op byte.
+    """
+    if not isinstance(rec, tuple) or not rec:
+        raise SchemaError(
+            f"op record is {type(rec).__name__}, expected non-empty tuple")
+    op = rec[0]
+    if isinstance(op, bool) or not isinstance(op, int):
+        raise SchemaError(f"op byte is {type(op).__name__}, expected int")
+    arity = schema.get(op)
+    if arity is None:
+        raise SchemaError(f"unknown op {op}")
+    lo, hi = arity
+    if not lo <= len(rec) <= hi:
+        raise SchemaError(
+            f"op {op} arity {len(rec)} outside whitelist [{lo}, {hi}]")
+    return op

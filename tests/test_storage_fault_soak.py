@@ -1,0 +1,61 @@
+"""Storage fault soak smoke + slow full run (``benchmarks/storage_fault_soak.py``).
+
+The tier-1 smoke drives one shortened seeded soak with crash/recover
+interleaved against bit-flip and torn-write episodes: the S1 per-slot
+ledger must stay clean, no acked decision may be silently lost (each is
+either in every live replica's table or the victim visibly fail-stopped),
+live replicas must converge after the drain, and every episode must
+resolve to a known outcome.  The framing smoke checks the v2 (kind + seq
++ barrier) framing stays under the 2% append+fsync overhead gate.  The
+``slow`` test runs the artifact-sized parameters (all four fault classes
+across multiple seeds, as ``python benchmarks/storage_fault_soak.py``
+writes to ``results_storage_faults_pr10.json``).
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "benchmarks"))
+
+import storage_fault_soak  # noqa: E402
+
+OUTCOMES = {"recovered_clean", "recovered_degraded", "stayed_down",
+            "shed_then_resumed", "shed", "fault_not_tripped"}
+
+
+def test_storage_fault_soak_smoke():
+    r = storage_fault_soak.soak(0, total=160)
+    assert r["safety"]["violations"] == 0
+    assert r["safety"]["observations"] > 0  # ledger actually attached
+    assert r["lost_acked"] == [], r["lost_acked"]
+    assert r["live_dbs_converged"]
+    assert r["acked"] >= 20  # commits kept flowing between episodes
+    assert r["episodes"], "schedule produced no fault episodes"
+    for ep in r["episodes"]:
+        assert ep["outcome"] in OUTCOMES, ep
+    # at least one episode actually damaged a WAL and the node came back
+    assert any(ep["outcome"].startswith("recovered")
+               for ep in r["episodes"]), r["episodes"]
+
+
+def test_framing_overhead_smoke():
+    fo = storage_fault_soak.framing_overhead(n=300, reps=3)
+    assert fo["pass"], fo  # paired A/B overhead under the 2% gate
+    assert fo["v1_us_per_op"] > 0 and fo["v2_us_per_op"] > 0
+
+
+@pytest.mark.slow
+def test_storage_fault_soak_full_artifact_parameters():
+    """Artifact-sized run: every fault class, multiple seeds, zero S1
+    violations and zero silently-lost acked decisions."""
+    runs = [storage_fault_soak.soak(seed, total=360) for seed in range(6)]
+    assert sum(r["safety"]["violations"] for r in runs) == 0
+    assert sum(len(r["lost_acked"]) for r in runs) == 0
+    exercised = {cls for r in runs
+                 for cls, outs in r["outcomes_by_class"].items() if outs}
+    assert exercised == set(storage_fault_soak.FAULT_CLASSES), exercised
+    fo = storage_fault_soak.framing_overhead()
+    assert fo["pass"], fo

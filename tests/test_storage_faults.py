@@ -1,0 +1,521 @@
+"""Storage fault-model tests: disk misbehavior as a first-class input.
+
+The WAL claims four recoverable disk behaviors (wal/journal.py docstring):
+torn writes, scribbles, fsync errors, and disk-full.  These tests pin the
+per-fault contract the storage soak (benchmarks/storage_fault_soak.py)
+exercises statistically:
+
+* scan classification is correct under randomized tears / flips / short
+  writes, and the reopen decision follows it (repair tears, refuse
+  scribbles);
+* both journal backends write byte-identical files and make identical
+  recovery decisions under the same fault script;
+* v1-format journals (the previous on-disk format) still replay;
+* a corrupt snapshot falls back a generation instead of loading garbage;
+* fsync failure is sticky fail-stop (fsyncgate), disk-full sheds with the
+  retriable convention; Mode B quarantines scribbles and degrades to peer
+  repair (or fail-stops when degraded recovery is disallowed).
+"""
+
+import glob
+import os
+import random
+import shutil
+import socket
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from gigapaxos_tpu.config import GigapaxosTpuConfig
+from gigapaxos_tpu.models.replicable import KVApp
+from gigapaxos_tpu.obs.metrics import registry
+from gigapaxos_tpu.paxos.manager import PaxosManager
+from gigapaxos_tpu.testing import faultdisk
+from gigapaxos_tpu.wal import records
+from gigapaxos_tpu.wal.journal import (MAGIC, MAGIC2, JournalCorruptError,
+                                       PyJournal, _valid_length,
+                                       read_journal, scan_journal)
+from gigapaxos_tpu.wal.logger import (OP_CREATE, OP_SCHEMA, PaxosLogger,
+                                      WalFailedError, WalQuarantinedError,
+                                      recover)
+
+
+def _mk(tmp_path, ckpt_every=1024):
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 16
+    apps = [KVApp() for _ in range(3)]
+    wal = PaxosLogger(str(tmp_path), checkpoint_every_ticks=ckpt_every,
+                      native=False)
+    return cfg, apps, PaxosManager(cfg, 3, apps, wal=wal)
+
+
+def _v2_frame(seq: int, kind: int, payload: bytes) -> bytes:
+    body = struct.pack("<BQ", kind, seq) + payload
+    return struct.pack("<II", len(body), zlib.crc32(body)) + body
+
+
+def _v1_frame(payload: bytes) -> bytes:
+    return struct.pack("<II", len(payload), zlib.crc32(payload)) + payload
+
+
+# --------------------------------------------------------- scan properties
+def _build_journal(path: str, rng: random.Random):
+    """Write a journal with random records and random sync points."""
+    j = PyJournal(path)
+    recs = []
+    for _ in range(rng.randrange(4, 12)):
+        r = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 40)))
+        j.append(r)
+        recs.append(r)
+        if rng.random() < 0.4:
+            j.sync()
+    j.close()  # final sync: every record ends up behind a barrier
+    return recs
+
+
+def test_scan_classification_randomized(tmp_path):
+    """Property test: under a random tear / bit flip / garbage short-write,
+    the scan (a) never raises, (b) returns an exact prefix of the original
+    records, and (c) the reopen decision matches the classification —
+    tears are repaired in place, scribbles refuse to open."""
+    for seed in range(24):
+        rng = random.Random(seed)
+        p = str(tmp_path / f"j{seed}.log")
+        recs = _build_journal(p, rng)
+        size = os.path.getsize(p)
+        mutation = rng.choice(("tear", "flip", "garbage"))
+        if mutation == "tear":
+            faultdisk.tear_tail(p, rng.randrange(1, size - 8), rng=rng)
+        elif mutation == "flip":
+            faultdisk.flip_byte(p, rng.randrange(8, size), rng=rng)
+        else:
+            with open(p, "ab") as f:
+                f.write(bytes(rng.randrange(256)
+                              for _ in range(rng.randrange(1, 12))))
+
+        scan = scan_journal(p)
+        n = len(scan.records)
+        assert scan.records == recs[:n], (seed, mutation)
+        assert scan.n_synced <= n
+        assert scan.good_len <= scan.file_size
+        assert _valid_length(p) == scan.good_len
+        for s in scan.suffix:  # resynced payloads are original records
+            assert s in recs, (seed, mutation)
+        if mutation == "garbage":
+            # appended garbage never parses as frames: classic torn tail
+            assert scan.kind == "torn_tail", seed
+
+        if scan.kind == "scribble":
+            before = os.path.getsize(p)
+            with pytest.raises(JournalCorruptError):
+                PyJournal(p)
+            # evidence preserved: refusing to open must not truncate
+            assert os.path.getsize(p) == before, (seed, mutation)
+        else:
+            j = PyJournal(p)
+            j.append(b"post-fault")
+            j.close()
+            assert read_journal(p) == scan.records + [b"post-fault"]
+
+
+def test_damaged_magic_is_scribble(tmp_path):
+    p = str(tmp_path / "m.log")
+    j = PyJournal(p)
+    j.append(b"rec")
+    j.close()
+    faultdisk.flip_byte(p, offset=3)
+    scan = scan_journal(p)
+    assert scan.kind == "scribble" and scan.version == 0
+    with pytest.raises(JournalCorruptError):
+        PyJournal(p)
+
+
+def test_barrier_bounds_acked_region(tmp_path):
+    """Damage past the last barrier is a tear (never fsync-acked); the
+    same damage before a barrier is a scribble."""
+    def build(path):
+        j = PyJournal(path)
+        j.append(b"acked-1")
+        j.append(b"acked-2")
+        j.sync()  # barrier: everything above is fsynced
+        j.append(b"unsynced")
+        j._flush_pending()  # bytes reached the page cache...
+        j._f.close()  # ...but the node crashed before the fsync/barrier
+        return scan_journal(path)
+
+    p = str(tmp_path / "b.log")
+    scan = build(p)
+    assert scan.kind == "clean"
+    assert scan.records == [b"acked-1", b"acked-2", b"unsynced"]
+    assert scan.n_synced == 2  # the unsynced tail record is not covered
+
+    # flip inside the unsynced trailing record -> torn tail, repairable
+    faultdisk.flip_byte(p, offset=scan.file_size - 2)
+    assert scan_journal(p).kind == "torn_tail"
+
+    # same flip inside the fsynced region (intact frames after) -> scribble
+    p2 = str(tmp_path / "b2.log")
+    build(p2)
+    faultdisk.flip_byte(p2, offset=8 + 4)  # first frame's CRC field
+    assert scan_journal(p2).kind == "scribble"
+
+
+# ----------------------------------------------- backend parity under faults
+def _native_or_skip():
+    try:
+        from gigapaxos_tpu.wal.native_journal import NativeJournal
+    except Exception:
+        pytest.skip("native toolchain unavailable")
+    return NativeJournal
+
+
+def _run_script(j, script):
+    for op, payload in script:
+        if op == "append":
+            j.append(payload)
+        else:
+            j.sync()
+    j.close()
+
+
+def test_py_native_bit_identical_and_same_fault_decisions(tmp_path):
+    """Satellite: the two backends write byte-identical files and reach
+    identical recovery decisions under the same fault script."""
+    NativeJournal = _native_or_skip()
+    for seed in range(6):
+        rng = random.Random(1000 + seed)
+        script = []
+        for _ in range(rng.randrange(3, 10)):
+            script.append(("append", bytes(
+                rng.randrange(256) for _ in range(rng.randrange(0, 64)))))
+            if rng.random() < 0.5:
+                script.append(("sync", None))
+        pp = str(tmp_path / f"py{seed}.log")
+        np_ = str(tmp_path / f"nat{seed}.log")
+        _run_script(PyJournal(pp), script)
+        _run_script(NativeJournal(np_), script)
+        with open(pp, "rb") as f:
+            py_bytes = f.read()
+        with open(np_, "rb") as f:
+            nat_bytes = f.read()
+        assert py_bytes == nat_bytes, f"seed {seed}: backends diverge"
+
+        # identical tear -> identical repair by either backend
+        drop = rng.randrange(1, min(32, len(py_bytes) - 9))
+        for p in (pp, np_):
+            faultdisk.tear_tail(p, drop)
+        _run_script(PyJournal(pp), [("append", b"after")])
+        _run_script(NativeJournal(np_), [("append", b"after")])
+        with open(pp, "rb") as f:
+            py_bytes = f.read()
+        with open(np_, "rb") as f:
+            nat_bytes = f.read()
+        assert py_bytes == nat_bytes, f"seed {seed}: repair diverges"
+        assert read_journal(pp)[-1] == b"after"
+
+        # identical scribble -> both refuse to open
+        scan = scan_journal(pp)
+        if len(scan.records) >= 2:
+            off = 8 + 4  # CRC field of the first frame: fsynced, resyncable
+            for p, cls in ((pp, PyJournal), (np_, NativeJournal)):
+                faultdisk.flip_byte(p, offset=off, rng=random.Random(7))
+                assert scan_journal(p).kind == "scribble"
+                with pytest.raises(JournalCorruptError):
+                    cls(p)
+
+
+# ------------------------------------------------------------ v1 compat
+def test_v1_journal_reads_tears_and_scribbles(tmp_path):
+    p = str(tmp_path / "v1.log")
+    recs = [b"alpha", b"beta" * 20, b"", b"gamma"]
+    with open(p, "wb") as f:
+        f.write(MAGIC)
+        for r in recs:
+            f.write(_v1_frame(r))
+    scan = scan_journal(p)
+    assert (scan.version, scan.kind) == (1, "clean")
+    assert scan.records == recs
+    # v1 has no barriers: every intact record counts as potentially acked
+    assert scan.n_synced == len(recs)
+    # tear: drop half of the final frame
+    size = os.path.getsize(p)
+    faultdisk.tear_tail(p, len(_v1_frame(b"gamma")) // 2)
+    assert scan_journal(p).kind == "torn_tail"
+    # rebuild, then flip inside the first frame: intact frames parse to
+    # EOF after the damage, so v1 resync classifies it as a scribble
+    with open(p, "wb") as f:
+        f.write(MAGIC)
+        for r in recs:
+            f.write(_v1_frame(r))
+    assert os.path.getsize(p) == size
+    faultdisk.flip_byte(p, offset=8 + 4)
+    scan = scan_journal(p)
+    assert scan.kind == "scribble"
+    assert scan.suffix == recs[1:]
+
+
+def test_v1_format_logger_replay_compat(tmp_path):
+    """Acceptance: journals written by the previous on-disk format (v1,
+    no kind/seq/barriers) still recover.  Seeding the journal file with
+    the v1 magic makes PyJournal continue it in v1 — exactly the state of
+    a directory produced by the pre-v2 code."""
+    seeded = str(tmp_path / "journal.00000000.log")
+    with open(seeded, "wb") as f:
+        f.write(MAGIC)
+    cfg, apps, m = _mk(tmp_path)
+    m.create_paxos_instance("svc", [0, 1, 2])
+    done = []
+    m.propose("svc", b"PUT k v", lambda _r, resp: done.append(resp))
+    m.run_ticks(4)
+    assert done == [b"OK"]
+    db_before = [dict(a.db) for a in apps]
+    m.wal.close()
+    with open(seeded, "rb") as f:
+        assert f.read(8) == MAGIC  # the run really wrote v1 format
+
+    apps2 = [KVApp() for _ in range(3)]
+    m2 = recover(cfg, 3, apps2, str(tmp_path), native=False)
+    for r in range(3):
+        assert apps2[r].db == db_before[r]
+    got = []
+    m2.propose("svc", b"GET k", lambda _r, resp: got.append(resp))
+    m2.run_ticks(3)
+    assert got == [b"v"]
+    m2.wal.close()
+
+
+# ------------------------------------------------- replay decode policy
+def _write_mode_a_journal(path: str, bodies):
+    with open(path, "wb") as f:
+        f.write(MAGIC2)
+        for i, (kind, payload) in enumerate(bodies, 1):
+            f.write(_v2_frame(i, kind, payload))
+
+
+def test_undecodable_tail_frame_tolerated(tmp_path):
+    """A CRC-valid but undecodable record past the last barrier was never
+    acked: replay drops it (counted) instead of fail-stopping."""
+    create = records.dumps((OP_CREATE, "svc", [0, 1, 2], 0))
+    _write_mode_a_journal(
+        str(tmp_path / "journal.00000000.log"),
+        [(0, create), (1, b""), (0, b"\xffnot-a-record")])
+    tol = registry().counter("wal_replay_tolerated_frames_total")
+    before = tol.value
+    m = recover(GigapaxosTpuConfig(), 3, [KVApp() for _ in range(3)],
+                str(tmp_path), native=False)
+    assert "svc" in m.rows
+    assert tol.value == before + 1
+    m.wal.close()
+
+
+def test_undecodable_fsynced_frame_fail_stops(tmp_path):
+    """The same garbage record *before* a barrier is corrupt acked data:
+    refuse to silently skip it."""
+    create = records.dumps((OP_CREATE, "svc", [0, 1, 2], 0))
+    _write_mode_a_journal(
+        str(tmp_path / "journal.00000000.log"),
+        [(0, create), (0, b"\xffnot-a-record"), (1, b"")])
+    with pytest.raises(WalQuarantinedError):
+        recover(GigapaxosTpuConfig(), 3, [KVApp() for _ in range(3)],
+                str(tmp_path), native=False)
+
+
+def test_mode_a_scribble_fail_stops_with_evidence(tmp_path):
+    """Mode A has no peer copy of its WAL: a scribble is fail-stop, and
+    the damaged file is left in place (not truncated, not renamed)."""
+    cfg, apps, m = _mk(tmp_path)
+    m.create_paxos_instance("svc", [0, 1, 2])
+    for i in range(6):
+        m.propose("svc", f"PUT k{i} v{i}".encode())
+    m.run_ticks(6)
+    m.wal.close()
+    (journal,) = glob.glob(str(tmp_path / "journal.*.log"))
+    size = os.path.getsize(journal)
+    faultdisk.flip_byte(journal, offset=8 + 4)  # first frame's CRC field
+    assert scan_journal(journal).kind == "scribble"
+    with pytest.raises(WalQuarantinedError):
+        recover(cfg, 3, [KVApp() for _ in range(3)], str(tmp_path),
+                native=False)
+    assert os.path.exists(journal) and os.path.getsize(journal) == size
+
+
+# ------------------------------------------------------ snapshot fallback
+def test_corrupt_snapshot_falls_back_a_generation(tmp_path):
+    cfg, apps, m = _mk(tmp_path, ckpt_every=4)
+    m.create_paxos_instance("svc", [0, 1, 2])
+    for i in range(10):
+        m.propose("svc", f"PUT k{i} v{i}".encode())
+    m.run_ticks(9)  # >= 2 checkpoints at ckpt_every=4
+    snaps = sorted(glob.glob(str(tmp_path / "snapshot.*.bin")))
+    assert len(snaps) >= 2
+    db_before = [dict(a.db) for a in apps]
+    tick_before = m.tick_num
+    m.wal.close()
+
+    faultdisk.flip_byte(snaps[-1], offset=os.path.getsize(snaps[-1]) // 2)
+    fb = registry().counter("snapshot_fallbacks_total")
+    before = fb.value
+    apps2 = [KVApp() for _ in range(3)]
+    m2 = recover(cfg, 3, apps2, str(tmp_path), native=False)
+    assert fb.value == before + 1
+    assert os.path.exists(snaps[-1] + ".corrupt")  # renamed aside
+    assert m2.tick_num == tick_before
+    for r in range(3):
+        assert apps2[r].db == db_before[r]
+    m2.wal.close()
+
+
+# ------------------------------------------------ fsyncgate + disk-full
+def test_fsync_error_is_sticky_fail_stop(tmp_path):
+    injector = faultdisk.install()
+    try:
+        cfg, apps, m = _mk(tmp_path)
+        m.create_paxos_instance("svc", [0, 1, 2])
+        m.propose("svc", b"PUT a 1")
+        m.run_ticks(2)
+        assert injector.arm(str(tmp_path), "fsync_error")
+        m.propose("svc", b"PUT b 2")
+        with pytest.raises(WalFailedError):
+            m.run_ticks(2)
+        assert m.wal.failed and not m.wal.accepting_writes()
+        # sticky: new writes are refused up front, no retry-and-ack-vapor
+        assert m.propose("svc", b"PUT c 3") is None
+        assert m.stats["shed_requests"] >= 1
+        # the journal itself refuses further appends too
+        with pytest.raises(WalFailedError):
+            m.wal._append(b"zombie write")
+    finally:
+        faultdisk.uninstall()
+
+
+def test_disk_full_sheds_retriable_then_resumes(tmp_path):
+    cfg, apps, m = _mk(tmp_path)
+    m.create_paxos_instance("svc", [0, 1, 2])
+    m.run_ticks(2)
+    shed_c = registry().counter("wal_shed_writes_total")
+    before = shed_c.value
+    m.wal.shedding = True  # what the free-bytes watermark trips
+    done = []
+    assert m.propose("svc", b"PUT a 1", lambda _r, resp: done.append(resp)) \
+        is None
+    rids = m.propose_bulk(np.array([0, 0]), [b"PUT b 2", b"PUT c 3"])
+    assert (rids == -2).all()  # whole batch shed, retriable code
+    m.run_ticks(2)  # flush held callbacks; reads/pipeline keep ticking
+    assert done == [None]  # the retriable-failure convention
+    assert shed_c.value >= before + 2
+    assert m.stats["shed_requests"] >= 3
+
+    m.wal.shedding = False  # hysteresis cleared: space came back
+    got = []
+    assert m.propose("svc", b"PUT d 4",
+                     lambda _r, resp: got.append(resp)) is not None
+    m.run_ticks(3)
+    assert got == [b"OK"]
+    m.wal.close()
+
+
+# --------------------------------------------------- Mode B scribble path
+def _drive_modeb_trio(tmp_path):
+    from gigapaxos_tpu.modeb.logger import ModeBLogger
+    from gigapaxos_tpu.modeb.manager import ModeBNode
+    from gigapaxos_tpu.testing.simnet import SimNet
+
+    ids = ["N0", "N1", "N2"]
+    net = SimNet(seed=3)
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 8
+    apps = {n: KVApp() for n in ids}
+    dirs = {n: str(tmp_path / n) for n in ids}
+    nodes = {
+        n: ModeBNode(cfg, ids, n, apps[n], net.messenger(n),
+                     wal=ModeBLogger(dirs[n], native=False),
+                     anti_entropy_every=8)
+        for n in ids
+    }
+    for nd in nodes.values():
+        nd.create_group("svc", [0, 1, 2])
+    done = []
+    nodes["N0"].propose("svc", b"PUT a 1", lambda _r, resp: done.append(resp))
+    for _ in range(120):
+        for nd in nodes.values():
+            nd.tick()
+        net.pump()
+        if done:
+            break
+    assert done == [b"OK"]
+    for _ in range(4):  # let the commit's frames reach every journal
+        for nd in nodes.values():
+            nd.tick()
+        net.pump()
+    for nd in nodes.values():
+        nd.wal.close()
+    return cfg, ids, dirs
+
+
+def test_modeb_scribble_quarantines_and_degrades(tmp_path):
+    from gigapaxos_tpu.modeb.logger import recover_modeb
+
+    cfg, ids, dirs = _drive_modeb_trio(tmp_path)
+    victim = dirs["N0"]
+    journal = faultdisk.newest_journal(victim)
+    faultdisk.flip_byte(journal, offset=os.path.getsize(journal) // 2)
+    assert scan_journal(journal).kind == "scribble"
+    failstop_copy = str(tmp_path / "N0_failstop")
+    shutil.copytree(victim, failstop_copy)
+
+    # policy A: degraded recovery disallowed -> fail-stop
+    with pytest.raises(WalQuarantinedError):
+        recover_modeb(cfg, ids, "N0", KVApp(), failstop_copy, native=False,
+                      allow_degraded=False)
+
+    # policy B (default): quarantine + blanket taint, repairable by peers
+    node = recover_modeb(cfg, ids, "N0", KVApp(), victim, native=False)
+    assert node.recovered_degraded
+    assert node._tainted_rows  # every own row awaits checkpoint repair
+    assert glob.glob(os.path.join(victim, "*.quarantined"))
+    # the reattached logger opened a FRESH journal at that seq — the
+    # damage lives only in the quarantined copy now
+    assert scan_journal(faultdisk.newest_journal(victim)).kind == "clean"
+    node.wal.close()
+
+
+# ------------------------------------------------------------- satellites
+def test_op_schema_whitelist():
+    from gigapaxos_tpu.wal.records import SchemaError, validate_op_record
+
+    assert validate_op_record((OP_CREATE, "svc", [0], 0),
+                              OP_SCHEMA) == OP_CREATE
+    with pytest.raises(SchemaError):
+        validate_op_record(["not", "a", "tuple"], OP_SCHEMA)
+    with pytest.raises(SchemaError):
+        validate_op_record((), OP_SCHEMA)
+    with pytest.raises(SchemaError):
+        validate_op_record((True, "bool-is-not-an-op"), OP_SCHEMA)
+    with pytest.raises(SchemaError):
+        validate_op_record((99, "unknown op"), OP_SCHEMA)
+    with pytest.raises(SchemaError):
+        validate_op_record((OP_CREATE, "arity", "way", "too", "long", 9),
+                           OP_SCHEMA)
+
+
+def test_transport_corrupt_frame_counter():
+    from gigapaxos_tpu.net.transport import _HDR, MAX_FRAME, FrameReader
+
+    a, b = socket.socketpair()
+    try:
+        reader = FrameReader(b)
+        reader.peer = "evil-peer"
+        c = registry().counter("transport_corrupt_frames_total",
+                               peer="evil-peer")
+        before = c.value
+        a.send(_HDR.pack(0, 1))  # length 0: below the 1-byte kind minimum
+        assert reader.next_frame() is None
+        assert c.value == before + 1
+        a.send(_HDR.pack(MAX_FRAME + 2, 1))  # absurd length
+        assert reader.next_frame() is None
+        assert c.value == before + 2
+    finally:
+        a.close()
+        b.close()

@@ -1,0 +1,154 @@
+"""Coordinator egress economics of the ordering/dissemination split
+(ISSUE 12; HT-Paxos / HT-Ring Paxos, arxiv 1407.1237 / 1507.04086).
+
+Before the split, every decision's payload fanned out from the
+coordinator to R-1 peers, so coordinator bytes/decision grew linearly
+with replica count — the tax the 3R -> 5R drop in
+``results_stack_pr5.json`` measures.  With digest ordering the frames
+carry rids only and payload bytes ride the dissemination ring (one
+downstream send per node per tick), so the ingress node's egress per
+decision is ~constant in R.
+
+This bench drives KB-payload writes through a SimNet Mode B cluster at
+R in {3, 5, 7} — ALL traffic entering at N0, the payload origin whose
+egress the split is about — and reads that node's egress straight off
+the node stats the `egress_bytes_per_decision` gauge is built from
+(frame_bytes_sent + relay_bytes_sent).  Every write is exactly one
+Paxos decision and every arm commits all of them (asserted), so the
+per-decision denominator is the committed write count.  Two arms:
+
+* ``ring on``  — digest ordering + ring dissemination (the new default
+  shape at scale): bytes/decision must stay ~flat (exit criterion:
+  7R <= 1.2x the 3R value);
+* ``ring off`` — digest ordering with the pre-split entry broadcast:
+  bytes/decision must grow ~linearly in R (each payload still leaves the
+  entry node R-1 times).
+
+Usage:  python benchmarks/egress_bench.py [--payload 16384] [--writes 24]
+        [--json out.json]
+Prints one JSON line per (R, arm) and, with --json, writes the artifact
+consumed by run_artifacts.py (results_egress_pr12.json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPLICAS = (3, 5, 7)
+
+
+def run_arm(R: int, ring: bool, payload_bytes: int, writes: int) -> dict:
+    from gigapaxos_tpu.config import GigapaxosTpuConfig
+    from gigapaxos_tpu.modeb import ModeBNode
+    from gigapaxos_tpu.models.replicable import KVApp
+    from gigapaxos_tpu.testing.simnet import SimNet
+
+    ids = [f"N{i}" for i in range(R)]
+    net = SimNet(seed=7)
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 8
+    cfg.paxos.window = 8
+    cfg.paxos.digest_accepts = True
+    cfg.paxos.ring_dissemination = ring
+    apps = {n: KVApp() for n in ids}
+    nodes = {n: ModeBNode(cfg, ids, n, apps[n], net.messenger(n),
+                          anti_entropy_every=8) for n in ids}
+    for nd in nodes.values():
+        nd.create_group("svc", list(range(R)))
+
+    def pump(k: int) -> None:
+        for _ in range(k):
+            for nd in nodes.values():
+                nd.tick()
+            net.pump()
+
+    # settle coordinatorship on N0 (slot 0) before measuring
+    warm = []
+    nodes["N0"].propose("svc", b"PUT warm 1",
+                        lambda _r, resp: warm.append(resp))
+    pump(20)
+    assert warm == [b"OK"], warm
+    n0 = nodes["N0"]
+    for k in ("frame_bytes_sent", "relay_bytes_sent"):
+        n0.stats[k] = 0
+
+    body = "x" * payload_bytes
+    done = []
+    t0 = time.perf_counter()
+    for i in range(writes):
+        nodes["N0"].propose("svc", f"PUT k{i} {body}".encode(),
+                            lambda _r, resp: done.append(resp))
+        pump(3)
+    pump(30)
+    dt = time.perf_counter() - t0
+
+    ok = sum(1 for r in done if r == b"OK")
+    assert ok == writes, (ok, writes)
+    egress = n0.stats["frame_bytes_sent"] + n0.stats["relay_bytes_sent"]
+    # every node converged on every write
+    dbs = [apps[n].db.get("svc", {}) for n in ids]
+    assert all(d == dbs[0] for d in dbs)
+    return {
+        "replicas": R,
+        "ring": ring,
+        "payload_bytes": payload_bytes,
+        "writes": writes,
+        "decisions": int(ok),
+        "egress_bytes": int(egress),
+        "egress_bytes_per_decision": round(egress / ok, 1),
+        "relay_bytes_sent": int(n0.stats["relay_bytes_sent"]),
+        "commits_per_s": round(ok / dt, 1),
+        "undigest_fills": int(sum(nd.stats["undigest_fills"]
+                                  for nd in nodes.values())),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--payload", type=int, default=16384)
+    ap.add_argument("--writes", type=int, default=24)
+    ap.add_argument("--json", default=None, help="artifact output path")
+    args = ap.parse_args()
+
+    runs = []
+    for ring in (True, False):
+        for R in REPLICAS:
+            r = run_arm(R, ring, args.payload, args.writes)
+            print(json.dumps(r))
+            runs.append(r)
+
+    def bpd(R: int, ring: bool) -> float:
+        return next(r["egress_bytes_per_decision"] for r in runs
+                    if r["replicas"] == R and r["ring"] is ring)
+
+    ratio_on = bpd(7, True) / bpd(3, True)
+    ratio_off = bpd(7, False) / bpd(3, False)
+    gate_pass = ratio_on <= 1.2 and ratio_off > 1.5
+    result = {
+        "bench": "egress",
+        "payload_bytes": args.payload,
+        "writes_per_arm": args.writes,
+        "ring_on_7R_over_3R": round(ratio_on, 3),
+        "ring_off_7R_over_3R": round(ratio_off, 3),
+        "gate": "ring-on bytes/decision at 7R <= 1.2x 3R; "
+                "ring-off grows > 1.5x",
+        "gate_pass": gate_pass,
+        "runs": runs,
+    }
+    print(json.dumps({k: v for k, v in result.items() if k != "runs"}))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        print(f"wrote {args.json}")
+    return 0 if gate_pass else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

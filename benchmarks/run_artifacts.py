@@ -217,6 +217,29 @@ def bench_modeb_scale() -> list:
     return _script(["benchmarks/modeb_scale.py", "--platform", "cpu"])
 
 
+def bench_egress() -> dict:
+    """Ordering/dissemination split (PR 12): refreshes the committed
+    results_egress_pr12.json and gates on its exit criterion — the
+    ingress node's egress bytes/decision at KB payloads must stay ~flat
+    in replica count with the ring on (7R <= 1.2x 3R) while the ring-off
+    broadcast arm grows linearly."""
+    r = _script(["benchmarks/egress_bench.py", "--json",
+                 "benchmarks/results_egress_pr12.json"])[-1]
+    if not r["gate_pass"]:
+        raise RuntimeError(
+            f"egress gate failed: ring_on 7R/3R={r['ring_on_7R_over_3R']} "
+            f"(need <= 1.2), ring_off={r['ring_off_7R_over_3R']} "
+            f"(need > 1.5)")
+    return {
+        "metric": "egress_bytes_per_decision_ring_on_7R_over_3R",
+        "value": r["ring_on_7R_over_3R"],
+        "unit": "ratio (<= 1.2 gates; ring-off broadcast arm: "
+                f"{r['ring_off_7R_over_3R']}x)",
+        "payload_bytes": r["payload_bytes"],
+        "writes_per_arm": r["writes_per_arm"],
+    }
+
+
 def bench_geo_soak() -> dict:
     """Region-loss SLO (benchmarks/geo_soak.py): refreshes the committed
     results_geo_soak_pr6.json and surfaces the headline here — simulated ms
@@ -404,6 +427,8 @@ def main() -> None:
     run("obs_overhead", bench_obs_overhead)
     # storage fault plane (PR 10): scribble/tear/fsyncgate/disk-full soak
     run("storage_faults", bench_storage_faults)
+    # ordering/dissemination split (PR 12): flat coordinator egress gate
+    run("egress", bench_egress)
 
     out = args.out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),

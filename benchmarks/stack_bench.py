@@ -238,6 +238,7 @@ def main() -> None:
                 decisions * (1 if args.baseline == "unreplicated" else R)
                 / dt, 1),
             "groups": G,
+            "replicas": R,
             "create_s": round(create_s, 2),
             "wal": bool(args.wal),
         },

@@ -118,6 +118,30 @@ class PaxosTuning:
     # stream (undigest fetches retried underneath) before the node gives
     # up and repairs by checkpoint transfer instead.
     undigest_timeout_ticks: int = 256
+    # Digest ordering becomes the DEFAULT at scale: a Mode B node whose
+    # boot universe has at least this many members turns digest_accepts on
+    # by itself (HT-Paxos, arxiv 1407.1237 — acceptors order ids, payload
+    # dissemination is a separate concern).  Coordinator egress otherwise
+    # grows linearly in R because every decision's payload fans out to
+    # R-1 peers.  0 disables the threshold; evaluated once at construction
+    # (a runtime expand_universe past the threshold does not flip a
+    # running cluster's wire protocol mid-flight).
+    digest_min_replicas: int = 5
+    # Ring payload dissemination (HT-Ring Paxos, arxiv 1507.04086): with
+    # digest ordering on, payload bytes leave a node on exactly ONE
+    # downstream link per tick — a columnar relay slab forwarded around
+    # the alive members in id order — instead of fanning out to R-1
+    # peers.  Each payload crosses each peer link at most once, so entry
+    # egress stays ~flat in R.  A slab lost to a crash mid-relay falls
+    # back to the undigest fetch + anti-entropy path.  No effect unless
+    # digest ordering is on (explicitly or via digest_min_replicas).
+    ring_dissemination: bool = True
+    # Mode A WAL payload dedup: log_inbox journals a payload's bytes once
+    # per checkpoint epoch; re-proposals of the same bytes journal an
+    # 8-byte digest reference instead (resolved during replay from the
+    # snapshot + earlier journal records, so recovery stays bit-identical).
+    # Pairs with the digest-keyed payload interning in paxos/manager.py.
+    wal_payload_dedup: bool = True
     # MEASUREMENT-ONLY baseline modes for attributing replication cost
     # (PaxosManager.java:1751-1799 emulateUnreplicated/emulateLazyPropagation,
     # EXECUTE_UPON_ACCEPT PaxosInstanceStateMachine.java:1077).  Never set

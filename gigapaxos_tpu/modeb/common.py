@@ -53,6 +53,18 @@ class ModeBCommon:
         #: digest-only accepts off unless the concrete node wires it from
         #: cfg.paxos.digest_accepts
         self._digest_accepts = False
+        #: ring payload dissemination (HT-Ring Paxos): when on, broadcast
+        #: frames carry NO payload table at all — every payload rides the
+        #: relay ring instead (one downstream send per tick).  Wired from
+        #: cfg.paxos.ring_dissemination by nodes that implement the relay.
+        self._ring_dissemination = False
+        #: own payloads staged for the next downstream relay slab
+        self._ring_out: list = []
+        #: rids already pushed onto the ring from here (re-placement after a
+        #: coordinator change must not re-disseminate; bounded like _routed)
+        self._ring_sent: "collections.OrderedDict[int, bool]" = (
+            collections.OrderedDict()
+        )
         self._fd = None
         self.on_work: Optional[Callable[[], None]] = None
         self.whois_birth: Optional[Callable[[str], bool]] = None
@@ -209,6 +221,7 @@ class ModeBCommon:
                     self._ae_phase == self.tick_num % self.anti_entropy_every
                 )
         digest = self._digest_accepts
+        ring = digest and self._ring_dissemination
         pay = []
         for row, take in self._placed:
             for rid, _p in take:
@@ -221,15 +234,38 @@ class ModeBCommon:
                     continue
                 rec = self.outstanding.get(rid)
                 if rec is not None:
-                    pay.append((rid, rec.stop, rec.payload))
+                    item = (rid, rec.stop, rec.payload)
                 elif rid in self.payloads:
                     pl, stop = self.payloads[rid]
-                    pay.append((rid, stop, pl))
+                    item = (rid, stop, pl)
+                else:
+                    continue
+                if ring:
+                    # ring dissemination: locally-entered payloads ride the
+                    # relay ring too — broadcast frames stay payload-free
+                    self._stage_ring(item)
+                else:
+                    pay.append(item)
         extra = getattr(self, "_extra_pay", None)
         if extra:
-            pay.extend(extra)
+            if ring:
+                for item in extra:
+                    self._stage_ring(item)
+            else:
+                pay.extend(extra)
             extra.clear()
         return full, mask, pay
+
+    def _stage_ring(self, item) -> None:
+        """Queue an own-origin payload for the next downstream relay slab,
+        once per rid (placement can repeat across coordinator changes)."""
+        rid = item[0]
+        if rid in self._ring_sent:
+            return
+        self._ring_sent[rid] = True
+        while len(self._ring_sent) > self._payload_cap:
+            self._ring_sent.popitem(last=False)
+        self._ring_out.append(item)
 
     def _build_frames_common(self, row_wire_bytes: int, extract, encode):
         """Shared fragmentation loop for both protocol flavors.

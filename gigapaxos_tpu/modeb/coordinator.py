@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 from typing import Callable, Dict, List, Optional
 
+from ..paxos.paystore import PayloadStore
 from ..reconfiguration.consistent_hashing import ConsistentHashRing
 from ..reconfiguration.coordinator import AbstractReplicaCoordinator
 from ..reconfiguration.rc_db import (
@@ -51,6 +52,11 @@ class ModeBReplicaCoordinator(AbstractReplicaCoordinator):
         # seeded by StartEpoch — whois self-birthing would create it empty
         # and silently lose the previous epoch's carried state
         node.whois_birth = lambda _name: False
+        # content-addressed interning at the SPI ingress: hot-key fan-out
+        # proposes the same body over and over; one shared bytes object per
+        # unique body keeps outstanding/payload tables and the WAL dedup
+        # epoch identity-stable (the Mode B face of paxos/paystore.py)
+        self._paystore = PayloadStore()
         self.node_ids = list(node.members)
         self._slot: Dict[str, int] = {n: i for i, n in enumerate(self.node_ids)}
         # runtime node additions append replica slots; keep the id<->slot
@@ -107,6 +113,8 @@ class ModeBReplicaCoordinator(AbstractReplicaCoordinator):
         if (self.node.rows.row(pname) is None or self.node.is_stopped(pname)
                 or self.node.is_tainted(pname)):
             return None
+        if isinstance(payload, bytes):
+            payload = self._paystore.intern(payload)
         return self.node.propose(pname, payload, callback)
 
     def create_replica_group(

@@ -290,3 +290,20 @@ def mirror_apply_impl(state, sr, rows, scalars, flags, rings, bits):
 
 
 mirror_apply = jax.jit(mirror_apply_impl, donate_argnums=(0,))
+
+
+def ring_downstream(alive, r: int) -> int:
+    """Next alive replica clockwise from ``r``: the dissemination-ring hop
+    target (HT-Ring Paxos, arxiv 1507.04086).  The tick above orders rids
+    only (digest accepts); the payload bytes those rids reference travel
+    along the ring this routing defines — one downstream send per node per
+    tick regardless of R.  Returns -1 when no OTHER replica is alive (a
+    singleton keeps its payloads staged until someone rejoins).  Host-side
+    like the unpack inverses: ``alive`` is the manager's numpy liveness
+    mirror, never device state."""
+    R = len(alive)
+    for k in range(1, R):
+        i = (r + k) % R
+        if alive[i]:
+            return i
+    return -1

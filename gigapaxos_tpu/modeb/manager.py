@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import collections
 import struct
+import time
 from typing import Callable, Dict, List, Optional
 
 import jax.numpy as jnp
@@ -49,8 +50,9 @@ from ..utils.reqtrace import tracer as _reqtrace
 from ..paxos import state as st
 from . import wire
 from .kernel import (frame_extract, mirror_apply, node_tick_device,
-                     node_tick_packed, unpack_frame_extract,
-                     unpack_node_tick, unpack_node_tick_device)
+                     node_tick_packed, ring_downstream,
+                     unpack_frame_extract, unpack_node_tick,
+                     unpack_node_tick_device)
 
 #: request ids are node-scoped: high bits carry the origin replica slot so
 #: any node can route the response duty without a lookup (the entry-replica
@@ -181,7 +183,46 @@ class ModeBNode(ModeBCommon):
         #: the device wait lands in "tally" at the unpack sync point
         self._pc = _phase_clock("modeb", plane=str(self.node_id))
         # ---- digest-only accepts (PendingDigests.java:23) ----
-        self._digest_accepts = bool(cfg.paxos.digest_accepts)
+        # Explicit opt-in, OR the default-at-scale threshold: past
+        # digest_min_replicas members, payload fan-out (R-1 copies per
+        # decision) dominates coordinator egress, so digest ordering
+        # becomes the default (HT-Paxos, arxiv 1407.1237).  Resolved once
+        # at construction — see the config.py knob for why.
+        _thresh = int(getattr(cfg.paxos, "digest_min_replicas", 0) or 0)
+        self._digest_accepts = bool(cfg.paxos.digest_accepts) or (
+            0 < _thresh <= self.R
+        )
+        # ---- ring payload dissemination (HT-Ring Paxos, 1507.04086) ----
+        # Only meaningful on top of digest ordering: ordering frames carry
+        # rids, payload bytes ride a relay slab around the alive members —
+        # one upstream recv + one downstream send per tick per node.
+        self._ring_dissemination = self._digest_accepts and bool(
+            getattr(cfg.paxos, "ring_dissemination", False)
+        )
+        #: upstream slabs staged for the downstream hop:
+        #: (RelaySlab, precomputed forward mask sans the downstream drop)
+        self._relay_fwd: list = []
+        #: rids already relayed through here (cycle breaker; see
+        #: _mark_relayed), bounded like the payload store
+        self._relay_seen: "collections.OrderedDict[int, bool]" = (
+            collections.OrderedDict()
+        )
+        from ..obs.metrics import registry as _obs_registry
+
+        #: derived egress efficiency gauge: (broadcast + relay bytes this
+        #: node sent) / decisions it has ordered — the number the ring is
+        #: designed to hold ~flat in R (see benchmarks/egress_bench.py)
+        self._egress_g = _obs_registry().gauge(
+            "egress_bytes_per_decision",
+            "frame+relay egress bytes per ordered decision",
+            node=str(node_id),
+        )
+        #: ring hop latency: upstream slab send -> local receive
+        self._ring_hop_h = _obs_registry().histogram(
+            "ring_hop_seconds",
+            "relay slab latency across one ring hop",
+            node=str(node_id),
+        )
         #: rid -> stop flag for digest proposals whose payload has not
         #: arrived yet (placement needs only the rid + stop)
         self._digest_meta: "collections.OrderedDict[int, bool]" = (
@@ -251,7 +292,9 @@ class ModeBNode(ModeBCommon):
         prev = d.bytes_handler
 
         def on_bytes(sender: str, payload: bytes) -> None:
-            if payload.startswith(wire.BATCH_MAGIC):
+            if payload.startswith(wire.RELAY_MAGIC):
+                self._on_relay(sender, payload)
+            elif payload.startswith(wire.BATCH_MAGIC):
                 # per-(peer, tick) container: split and journal/apply each
                 # sub-frame individually, so WAL replay sees exactly the
                 # records a singly-sent stream would have produced
@@ -822,6 +865,7 @@ class ModeBNode(ModeBCommon):
                     and self.tick_num % 256 == 0 and len(self.rows) > 0):
                 self.pause_idle()
             frames = self._build_frames()
+            relay = self._build_relay()
             pc.mark("outbox_pack")
             if self.wal is not None:
                 self.wal.maybe_checkpoint()
@@ -844,6 +888,24 @@ class ModeBNode(ModeBCommon):
                         # anti-entropy full frame re-ships state anyway
                         self.stats["send_failures"] += 1
         pc.mark("egress")
+        if relay is not None:
+            # the dissemination half of the split: payload bytes leave on
+            # exactly ONE downstream link, not R-1 (a slab lost to a crash
+            # here is refetched via undigest — see _on_relay)
+            dest, buf = relay
+            self.stats["relay_bytes_sent"] += len(buf)
+            self.stats["relay_frames_sent"] += 1
+            try:
+                self.m.send_bytes(dest, buf)
+            except SendFailure:
+                self.stats["send_failures"] += 1
+        pc.mark("ring_relay")
+        dec = self.stats["decisions"]
+        if dec:
+            self._egress_g.set(
+                (self.stats["frame_bytes_sent"]
+                 + self.stats["relay_bytes_sent"]) / dec
+            )
         pc.end()
         return out
 
@@ -1042,7 +1104,8 @@ class ModeBNode(ModeBCommon):
             self._stalled[row] = q
             self._stall_tick[row] = self.tick_num
             self.stats["stalled_rows"] += 1
-            self._undigest(rid, row)
+            if not self._ring_grace(rid):
+                self._undigest(rid, row)
             return
         else:
             # payload never seen (GC'd or dropped with a dead peer's
@@ -1104,7 +1167,22 @@ class ModeBNode(ModeBCommon):
                 continue
             self._stalled[row] = q
             self._stall_tick[row] = self.tick_num if progressed else t0
+            if age <= self.R and self._ring_grace(head_rid):
+                # bytes are (at most R-1 hops) in flight on the ring; let
+                # them land before burning an undigest round trip
+                continue
             self._undigest(head_rid, row)
+
+    def _ring_grace(self, rid: int) -> bool:
+        """True while a missing payload should still be EXPECTED from the
+        dissemination ring: ring mode is on and the rid's origin is alive,
+        so its slab is (at most R-1 hops) in flight.  Suppresses the
+        undigest fetch during a fresh stall — the fallback must not race
+        bytes the ring is already carrying.  A dead origin (stranded slab)
+        disables the grace and the fetch fires immediately."""
+        o = rid_origin(rid)
+        return (self._ring_dissemination and 0 <= o < self.R
+                and o != self.r and bool(self.alive[o]))
 
     def _undigest(self, rid: int, row: int) -> None:
         """Fetch a committed-but-unseen payload: ask the rid's ORIGIN node
@@ -1210,6 +1288,85 @@ class ModeBNode(ModeBCommon):
         return self._build_frames_common(
             self._row_wire_bytes(), extract, encode
         )
+
+    # ------------------------------------------------------------- ring relay
+    def _mark_relayed(self, rid: int) -> bool:
+        """First relay sighting of a rid here; False on a repeat (breaks
+        relay cycles when alive views diverge mid-crash: a slab that laps
+        the ring dies at the first node that already forwarded it)."""
+        if rid in self._relay_seen:
+            return False
+        self._relay_seen[rid] = True
+        while len(self._relay_seen) > self._payload_cap:
+            self._relay_seen.popitem(last=False)
+        return True
+
+    def _build_relay(self):
+        """Assemble this tick's downstream relay slab (lock held): the
+        node's own newly-entered payloads plus every upstream slab staged
+        by ``_on_relay``.  One frame to the next ALIVE member clockwise —
+        dissemination costs each node one payload-sized downstream link
+        per tick regardless of R, the HT-Ring egress shape."""
+        if not self._ring_dissemination or self.m is None:
+            return None
+        if not self._ring_out and not self._relay_fwd:
+            return None
+        d = ring_downstream(self.alive, self.r)
+        if d < 0:
+            return None  # no live downstream: stay staged for later ticks
+        groups = []
+        own, self._ring_out = self._ring_out, []
+        if own:
+            groups.append(wire.relay_group(own))
+        fwd, self._relay_fwd = self._relay_fwd, []
+        for slab, pre in fwd:
+            # the downstream drop rule: an item never travels INTO its
+            # origin, so each payload crosses exactly R-1 links — once per
+            # link, never twice over any of them
+            keep = pre & ((slab.rids >> RID_SHIFT) != d)
+            if keep.any():
+                groups.append(wire.slab_keep(slab, keep))
+        if not groups:
+            return None
+        buf = wire.encode_relay(self.r, self.tick_num, time.time(), groups)
+        return self.members[d], buf
+
+    def _on_relay(self, sender: str, payload: bytes) -> None:
+        """Upstream relay slab: adopt+journal unseen payloads, stage the
+        (masked) slab for the downstream hop.  A slab lost to a crash
+        between here and downstream is NOT retransmitted — receivers that
+        commit a rid without its payload refetch via the undigest path,
+        and anti-entropy repairs the stragglers."""
+        try:
+            slab = wire.decode_relay(payload)
+        except (ValueError, struct.error):
+            self.stats["bad_frames"] += 1
+            return
+        if slab.sent_s > 0:
+            self._ring_hop_h.observe(max(0.0, time.time() - slab.sent_s))
+        with self.lock:
+            self.stats["relay_frames_rcvd"] += 1
+            rids = slab.rids
+            self.bump_seq(rids)
+            n = len(rids)
+            fresh = np.fromiter(
+                (self._mark_relayed(rid) for rid in rids.tolist()), bool, n
+            )
+            offs, stops = slab.offs, slab.stops.tolist()
+            for i, rid in enumerate(rids.tolist()):
+                if not fresh[i] or rid in self.payloads:
+                    continue
+                body = bytes(slab.blob[int(offs[i]): int(offs[i + 1])])
+                self._store_payload(rid, body, bool(stops[i]))
+                self.stats["relay_payloads"] += 1
+                if self.wal is not None:
+                    # journaled like an undigest fill so WAL replay of a
+                    # ring deployment stays bit-identical (OP_PAYLOAD)
+                    self.wal.log_payload(rid, body, bool(stops[i]))
+            pre = fresh & ((rids >> RID_SHIFT) != self.r)
+            if pre.any():
+                self._relay_fwd.append((slab, pre))
+        self._wake()
 
     # ------------------------------------------------------------ frames (rx)
     def _on_frame(self, sender: str, payload: bytes) -> None:

@@ -246,3 +246,118 @@ def decode_frames(buf: bytes, magic: bytes = BATCH_MAGIC) -> List[bytes]:
         raise ValueError("frame-batch length mismatch")
     mv = memoryview(buf)
     return [bytes(mv[s:e]) for s, e in zip(starts.tolist(), ends.tolist())]
+
+
+# ---------------------------------------------------------- ring relay slabs
+#: Payload-dissemination relay frame (HT-Ring Paxos, arxiv 1507.04086).
+#: Ordering frames above carry only rids under digest mode; the payload
+#: bytes ride these slabs around the member ring instead — one upstream
+#: recv, one downstream send per node per tick.  Distinct magic keeps the
+#: bytes-handler prefix dispatch unambiguous next to MAGIC/BATCH_MAGIC.
+RELAY_MAGIC = b"GPXR"
+RELAY_VERSION = 1
+#: magic | u16 version | i32 sender_r | i64 tick | f64 sent_s (hop-latency
+#: timestamp, observability only — never journaled) | u32 n
+_RHDR = struct.Struct("<4sHiqdI")
+
+
+class RelaySlab(NamedTuple):
+    """A decoded relay frame, kept columnar: ``rid``/``stop``/``len``
+    column slabs plus ONE blob of concatenated payload bytes.  Forwarding
+    never decodes payload bodies — it masks the columns and re-slices the
+    blob (``slab_keep``), so a hop costs O(columns), not O(bytes parsed).
+    The payload's origin replica needs no column of its own: it lives in
+    the rid's high bits (``rid >> RID_SHIFT``, modeb/common.py)."""
+
+    sender_r: int
+    tick: int
+    sent_s: float
+    rids: np.ndarray   # i32 [n]
+    stops: np.ndarray  # bool [n]
+    lens: np.ndarray   # i64 [n]
+    offs: np.ndarray   # i64 [n+1] byte offsets into blob
+    blob: memoryview   # concatenated payload bytes
+
+    def items(self) -> List[Tuple[int, bool, bytes]]:
+        o = self.offs.tolist()
+        return [
+            (rid, stop, bytes(self.blob[o[i]: o[i + 1]]))
+            for i, (rid, stop) in enumerate(
+                zip(self.rids.tolist(), self.stops.tolist()))
+        ]
+
+
+def encode_relay(sender_r, tick, sent_s, groups) -> bytes:
+    """Encode one relay frame from column groups.
+
+    ``groups``: iterable of ``(rids, stops, lens, blob_parts)`` — one group
+    for the node's own newly-entered payloads, one per upstream slab being
+    forwarded (already masked by :func:`slab_keep`).  Columns concatenate;
+    blob parts are appended as-is, so forwarded bytes are never re-parsed.
+    """
+    rid_cols, stop_cols, len_cols, blobs = [], [], [], []
+    for rids, stops, lens, parts in groups:
+        rid_cols.append(np.ascontiguousarray(rids, np.int32))
+        stop_cols.append(np.ascontiguousarray(stops, np.uint8))
+        len_cols.append(np.ascontiguousarray(lens, np.uint32))
+        blobs.extend(parts)
+    rids = (np.concatenate(rid_cols) if rid_cols
+            else np.empty(0, np.int32))
+    n = len(rids)
+    parts = [
+        _RHDR.pack(RELAY_MAGIC, RELAY_VERSION, sender_r, tick, sent_s, n),
+        rids.tobytes(),
+        (np.concatenate(stop_cols) if stop_cols
+         else np.empty(0, np.uint8)).tobytes(),
+        (np.concatenate(len_cols) if len_cols
+         else np.empty(0, np.uint32)).tobytes(),
+    ]
+    parts.extend(blobs)
+    return b"".join(parts)
+
+
+def relay_group(items) -> Tuple[np.ndarray, np.ndarray, np.ndarray, list]:
+    """(rid, stop, payload) triples -> one encode_relay column group (the
+    entry node's own staging path; forwarded slabs never take this loop)."""
+    k = len(items)
+    rids = np.fromiter((it[0] for it in items), np.int32, k)
+    stops = np.fromiter((bool(it[1]) for it in items), np.uint8, k)
+    lens = np.fromiter((len(it[2]) for it in items), np.uint32, k)
+    return rids, stops, lens, [it[2] for it in items]
+
+
+def decode_relay(buf: bytes) -> RelaySlab:
+    hmagic, ver, sender_r, tick, sent_s, n = _RHDR.unpack_from(buf, 0)
+    if hmagic != RELAY_MAGIC or ver != RELAY_VERSION:
+        raise ValueError("bad relay frame header")
+    off = _RHDR.size
+    rids = np.frombuffer(buf, np.int32, n, off)
+    off += 4 * n
+    stops = np.frombuffer(buf, np.uint8, n, off) != 0
+    off += n
+    lens = np.frombuffer(buf, np.uint32, n, off).astype(np.int64)
+    off += 4 * n
+    offs = np.zeros(n + 1, np.int64)
+    np.cumsum(lens, out=offs[1:])
+    if off + int(offs[-1]) != len(buf):
+        raise ValueError("relay frame length mismatch")
+    return RelaySlab(sender_r, tick, sent_s, rids, stops, lens, offs,
+                     memoryview(buf)[off:])
+
+
+def slab_keep(slab: RelaySlab, keep: np.ndarray):
+    """Mask a slab for forwarding: kept columns + blob slices re-offset to
+    the kept byte ranges.  Contiguous kept runs coalesce into single
+    memoryview slices, so the common all-kept hop forwards the whole blob
+    as one zero-copy part.  Returns an encode_relay column group."""
+    idx = np.flatnonzero(keep)
+    parts = []
+    if idx.size:
+        brk = np.flatnonzero(np.diff(idx) > 1)
+        run_lo = np.concatenate(([0], brk + 1))
+        run_hi = np.concatenate((brk, [idx.size - 1]))
+        offs = slab.offs
+        for a, b in zip(run_lo.tolist(), run_hi.tolist()):
+            parts.append(
+                slab.blob[int(offs[idx[a]]): int(offs[idx[b] + 1])])
+    return slab.rids[keep], slab.stops[keep], slab.lens[keep], parts

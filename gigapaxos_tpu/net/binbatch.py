@@ -15,6 +15,14 @@ Request frame  (client -> active):
   b"GBR1" | bid u64 | host u8+bytes | port u16 | client_id u8+bytes
   | n_names u16 | {u16 len + bytes} * n_names
   | n u32 | name_idx u16*n | rid u64*n | plen u32*n | payload blob
+Deduped request frame (ordering/dissemination split, Mode A bulk store):
+  b"GBR2" | <same header through rid u64*n>
+  | n_uniq u32 | ulen u32*n_uniq | pidx u32*n | unique payload blob
+  A batch whose items repeat a body (generated fan-out, hot-key writes)
+  ships each unique body ONCE per peer link; the receiver rebuilds the
+  per-item payload list with the duplicates sharing one bytes object —
+  the wire-side face of ``paxos/paystore.py``.  ``encode_request`` picks
+  GBR2 automatically when the bytes saved exceed the index overhead.
 Response frame (active -> client):
   b"GBS1" | bid u64 | n u32 | rid u64*n | status u8*n | rlen u32*n | blob
 """
@@ -30,6 +38,7 @@ import numpy as np
 from .transport import SendFailure
 
 REQ_MAGIC = b"GBR1"
+REQ2_MAGIC = b"GBR2"
 RESP_MAGIC = b"GBS1"
 
 
@@ -76,9 +85,9 @@ class ClientEgress:
             pass
 
 
-def encode_request(bid: int, host: str, port: int, client_id: str,
-                   items: List[Tuple[str, int, bytes]]) -> bytes:
-    """items: (name, rid, payload)."""
+def _request_head(magic: bytes, bid: int, host: str, port: int,
+                  client_id: str, items) -> Tuple[list, dict, int]:
+    """Shared GBR1/GBR2 header through ``rid u64*n``."""
     names: dict = {}
     for name, _rid, _p in items:
         if name not in names:
@@ -86,10 +95,9 @@ def encode_request(bid: int, host: str, port: int, client_id: str,
     n = len(items)
     idx = np.fromiter((names[it[0]] for it in items), np.uint16, n)
     rids = np.fromiter((it[1] for it in items), np.uint64, n)
-    plens = np.fromiter((len(it[2]) for it in items), np.uint32, n)
     hb = host.encode()
     cb = client_id.encode()
-    head = [REQ_MAGIC, struct.pack("<QB", bid, len(hb)), hb,
+    head = [magic, struct.pack("<QB", bid, len(hb)), hb,
             struct.pack("<HB", port, len(cb)), cb,
             struct.pack("<H", len(names))]
     for name in names:
@@ -97,14 +105,46 @@ def encode_request(bid: int, host: str, port: int, client_id: str,
         head.append(struct.pack("<H", len(nb)))
         head.append(nb)
     head.append(struct.pack("<I", n))
-    return b"".join(head) + idx.tobytes() + rids.tobytes() + plens.tobytes() \
-        + b"".join(it[2] for it in items)
+    head.append(idx.tobytes())
+    head.append(rids.tobytes())
+    return head, names, n
+
+
+def encode_request(bid: int, host: str, port: int, client_id: str,
+                   items: List[Tuple[str, int, bytes]]) -> bytes:
+    """items: (name, rid, payload).  Emits GBR2 (unique-payload table)
+    when the duplicate bytes it removes exceed the extra index overhead
+    (4 bytes/unique body), else plain GBR1 — decode sniffs the magic."""
+    n = len(items)
+    uniq: dict = {}  # body -> table index (content-keyed)
+    dup_bytes = 0
+    for _name, _rid, p in items:
+        if p in uniq:
+            dup_bytes += len(p)
+        else:
+            uniq[p] = len(uniq)
+    if dup_bytes > 4 * len(uniq):
+        head, _names, _n = _request_head(
+            REQ2_MAGIC, bid, host, port, client_id, items)
+        ulens = np.fromiter((len(p) for p in uniq), np.uint32, len(uniq))
+        pidx = np.fromiter((uniq[it[2]] for it in items), np.uint32, n)
+        head.append(struct.pack("<I", len(uniq)))
+        head.append(ulens.tobytes())
+        head.append(pidx.tobytes())
+        return b"".join(head) + b"".join(uniq)
+    head, _names, _n = _request_head(
+        REQ_MAGIC, bid, host, port, client_id, items)
+    plens = np.fromiter((len(it[2]) for it in items), np.uint32, n)
+    head.append(plens.tobytes())
+    return b"".join(head) + b"".join(it[2] for it in items)
 
 
 def decode_request(buf: bytes):
     """Returns (bid, (host, port), client_id, names, name_idx, rids,
-    payloads list of bytes)."""
-    assert buf[:4] == REQ_MAGIC
+    payloads list of bytes) for either request-frame kind; GBR2 duplicates
+    come back as the SAME bytes object (pre-interned for the admit path)."""
+    magic = buf[:4]
+    assert magic in (REQ_MAGIC, REQ2_MAGIC)
     o = 4
     bid, hlen = struct.unpack_from("<QB", buf, o)
     o += 9
@@ -128,11 +168,24 @@ def decode_request(buf: bytes):
     o += 2 * n
     rids = np.frombuffer(buf, np.uint64, n, o)
     o += 8 * n
+    mv = memoryview(buf)
+    if magic == REQ2_MAGIC:
+        (n_uniq,) = struct.unpack_from("<I", buf, o)
+        o += 4
+        ulens = np.frombuffer(buf, np.uint32, n_uniq, o)
+        o += 4 * n_uniq
+        pidx = np.frombuffer(buf, np.uint32, n, o)
+        o += 4 * n
+        uoffs = np.zeros(n_uniq + 1, np.int64)
+        np.cumsum(ulens, out=uoffs[1:])
+        utab = [bytes(mv[o + uoffs[i]:o + uoffs[i + 1]])
+                for i in range(n_uniq)]
+        payloads = [utab[i] for i in pidx]
+        return bid, (host, port), client_id, names, idx, rids, payloads
     plens = np.frombuffer(buf, np.uint32, n, o)
     o += 4 * n
     offs = np.zeros(n + 1, np.int64)
     np.cumsum(plens, out=offs[1:])
-    mv = memoryview(buf)
     payloads = [bytes(mv[o + offs[i]:o + offs[i + 1]]) for i in range(n)]
     return bid, (host, port), client_id, names, idx, rids, payloads
 

@@ -277,6 +277,8 @@ class _Peer:
                     n_sys = _send_frames(self.sock, batch)
                     self.t._count("sent", len(batch))
                     self.t._count("send_syscalls", n_sys)
+                    self.t._count_peer("tx_bytes", self.dest,
+                                      sum(len(b[2]) for b in batch))
                     self.t._batch_h.observe(len(batch))
                     break
                 except (OSError, struct.error):
@@ -402,6 +404,7 @@ class Transport:
             # unnecessary — the reference short-circuits identically)
             for payload in payloads:
                 self._count("loopback")
+                self._count_peer("tx_bytes", self.node_id, len(payload))
                 try:
                     self.demux(self.node_id, kind, payload)
                 except Exception:
@@ -470,6 +473,7 @@ class Transport:
                     return
                 kind, payload = frame
                 self._count("rcvd")
+                self._count_peer("rx_bytes", sender, len(payload))
                 t0 = time.monotonic()
                 try:
                     self.demux(sender, kind, payload)
@@ -495,6 +499,21 @@ class Transport:
             if c is None:
                 c = self._obs_counters[key] = _obs_registry().counter(
                     f"transport_{key}_total", node=self.node_id)
+        c.inc(n)
+
+    def _count_peer(self, key: str, peer: str, n: int = 1) -> None:
+        """Per-peer-link accounting: stats["<key>:<peer>"] plus a
+        peer-labelled counter family.  This is the instrument the
+        dissemination split is gated on — "each payload's bytes cross each
+        peer link once" is checked against these, not inferred."""
+        with self._slock:
+            k = f"{key}:{peer}"
+            self.stats[k] = self.stats.get(k, 0) + n
+            c = self._obs_counters.get(k)
+            if c is None:
+                c = self._obs_counters[k] = _obs_registry().counter(
+                    f"transport_peer_{key}_total",
+                    node=self.node_id, peer=peer)
         c.inc(n)
 
     def reset_peer(self, dest: str) -> None:

@@ -284,6 +284,7 @@ class InProcessCluster:
         wal = wal_logger.PaxosLogger(
             wal_dir, sync_every_ticks=cfg.paxos.sync_every_ticks,
             native=cfg.native_journal,
+            payload_dedup=getattr(cfg.paxos, "wal_payload_dedup", True),
         )
         return PaxosManager(cfg, n_slots, apps, wal=wal, spill_ns=ns)
 

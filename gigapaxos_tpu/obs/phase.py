@@ -28,9 +28,11 @@ DRIVER_PHASES: Dict[str, Tuple[str, ...]] = {
     # paxos/manager.py PaxosManager.tick/_complete_tick
     "modea": ("repair", "intake", "dispatch", "wal_fsync",
               "tally", "execute", "egress", "sweep"),
-    # modeb/manager.py ModeBNode.tick
+    # modeb/manager.py ModeBNode.tick (ring_relay: the one-downstream-send
+    # payload dissemination hop that replaces payload fan-out under
+    # cfg.paxos.ring_dissemination)
     "modeb": ("ingress", "intake", "dispatch", "wal_fsync",
-              "tally", "execute", "outbox_pack", "egress"),
+              "tally", "execute", "outbox_pack", "egress", "ring_relay"),
     # chain/manager.py ChainManager.tick
     "chain": ("intake", "dispatch", "wal_fsync", "tally", "execute"),
     # chain/modeb.py ChainModeBNode.tick

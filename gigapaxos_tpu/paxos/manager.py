@@ -47,6 +47,7 @@ import itertools as _itertools
 _MGR_SEQ = _itertools.count()
 from . import state as st
 from .bulkstore import BulkOverrun, BulkStore
+from .paystore import PayloadStore
 from ..ops.tick import (CompactHostOutbox, HostOutbox, TickInbox,
                         frontier_rows, paxos_tick_compact,
                         paxos_tick_compact_demand, paxos_tick_packed,
@@ -93,6 +94,11 @@ class PaxosManager:
         self.tick_num = 0
         self.outstanding: Dict[int, RequestRecord] = {}
         self._next_rid = 1
+        # content-addressed payload interning (ordering/dissemination split,
+        # Mode A half): N admitted requests sharing one body hold one bytes
+        # object, and every digest-keyed consumer (WAL dedup, GBR2 batch
+        # frames) sees identity-stable payloads
+        self._paystore = PayloadStore()
         self._queues: Dict[int, collections.deque] = collections.defaultdict(
             collections.deque
         )  # row -> rids waiting for intake
@@ -818,6 +824,8 @@ class PaxosManager:
 
     def _admit(self, rid, name, row, payload, callback, stop, entry) -> None:
         """Insert one request into the per-row queues (manager lock held)."""
+        if isinstance(payload, bytes):
+            payload = self._paystore.intern(payload)
         members = np.where(self._member_np[:, row])[0]
         if entry is None or entry not in members:
             # spread entry replicas across the group's members (not the whole
@@ -988,6 +996,14 @@ class PaxosManager:
             self.stats["decisions"] += n_adm
             out[np.nonzero(ok)[0][:n_adm]] = rid0 + np.arange(n_adm)
             return out
+        if not isinstance(payloads, (bytes, bytearray)):
+            # per-request bodies: intern so duplicates across the batch (and
+            # across batches) collapse to one shared object before the store,
+            # WAL, and batch frames ever see them
+            payloads = [
+                self._paystore.intern(p) if isinstance(p, bytes) else p
+                for p in payloads
+            ]
         rids = store.admit(rid0, rows.astype(np.int32), ent, stops,
                            payloads)
         if batch_sink is not None:

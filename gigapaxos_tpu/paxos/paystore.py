@@ -1,0 +1,76 @@
+"""Digest-keyed shared payload store (Mode A bulk dissemination).
+
+The ordering/dissemination split's Mode A half (HT-Paxos, arxiv
+1407.1237): accepts and commits in the compact outbox already reference
+requests by rid — what still multiplied payload bytes was every copy of
+the same body being carried separately through admission, the WAL inbox
+journal, and the client batch frames.  Interning by content digest makes
+"the payload's bytes" a single shared object per unique body, which the
+other layers key off:
+
+* ``paxos/manager.py`` interns at admission, so N outstanding requests
+  with one body hold one ``bytes``;
+* ``wal/logger.py`` journals a body once per checkpoint epoch and an
+  8-byte digest reference afterwards (replay resolves references from
+  the snapshot + earlier records, bit-identically);
+* ``net/binbatch.py`` ships a unique-payload table per batch frame, so a
+  body crosses each peer link once (GBR2).
+
+blake2b-64 keys (the same digest the Mode B wire uses for group ids);
+an equality check guards the store against digest collisions — a
+colliding body is simply never shared.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+from typing import Optional
+
+#: bodies below this aren't worth a digest reference (the reference
+#: record itself costs ~20 journal bytes)
+DEDUP_MIN_BYTES = 32
+
+
+def payload_digest(payload: bytes) -> bytes:
+    return hashlib.blake2b(payload, digest_size=8).digest()
+
+
+class PayloadStore:
+    """Bounded content-addressed interning of request bodies.
+
+    LRU-bounded like the Mode B payload table: eviction only loses
+    sharing (the next intern re-inserts), never correctness — every
+    consumer keeps its own reference to the returned object.
+    """
+
+    def __init__(self, cap: int = 1 << 16):
+        self._by_digest: "collections.OrderedDict[bytes, bytes]" = (
+            collections.OrderedDict()
+        )
+        self.cap = cap
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._by_digest)
+
+    def intern(self, payload: bytes) -> bytes:
+        """Return the canonical object for these bytes (may be ``payload``
+        itself on first sight).  Tiny bodies pass through untouched."""
+        if len(payload) < DEDUP_MIN_BYTES:
+            return payload
+        d = payload_digest(payload)
+        got = self._by_digest.get(d)
+        if got is not None and got == payload:
+            self.hits += 1
+            self._by_digest.move_to_end(d)
+            return got
+        self.misses += 1
+        self._by_digest[d] = payload
+        while len(self._by_digest) > self.cap:
+            self._by_digest.popitem(last=False)
+        return payload
+
+    def get(self, digest: bytes) -> Optional[bytes]:
+        return self._by_digest.get(digest)

@@ -175,8 +175,11 @@ class ActiveReplica:
         # (LargeCheckpointer analog, paxosutil/LargeCheckpointer.java:39)
         self.bulk = BulkTransfer(self.m)
         self.bulk.register_prefix("efs:", self._on_bulk_final_state)
-        # binary batched-request frames (SoA wire, net/binbatch.py)
+        # binary batched-request frames (SoA wire, net/binbatch.py); the
+        # deduped GBR2 kind shares the handler — decode sniffs the magic
         binbatch.chain_bytes_handler(self.m.demux, binbatch.REQ_MAGIC,
+                                     self._on_binary_batch)
+        binbatch.chain_bytes_handler(self.m.demux, binbatch.REQ2_MAGIC,
                                      self._on_binary_batch)
         # response egress coalesced per (client, tick): the manager's
         # callback flush opens a scope, every bid finished inside it stages,

@@ -574,3 +574,26 @@ def partition_flap(minority: str = "N0", period: int = 50, flaps: int = 3,
         evs.append(ChaosEvent(t + period // 2, "mark_up",
                               {"node": minority}))
     return ChaosSchedule("partition_flap", evs, seed=seed)
+
+
+def ring_crash(entry: str = "N1", victim: str = "N2", crash_at: int = 30,
+               recover_at: int = 140, detect_after: int = 4,
+               n_writes: int = 12, every: int = 2, group: str = "svc",
+               seed: int = 0) -> ChaosSchedule:
+    """SIGKILL the ring-upstream relay hop mid-dissemination (ordering/
+    dissemination split): writes enter at ``entry`` whose downstream relay
+    neighbor is ``victim`` (kernel.ring_downstream order), so slabs in
+    flight when the victim dies never reach the third node — it commits
+    the ordered rids digest-only and must fill the payloads through the
+    undigest path.  S1 must hold throughout and a WAL replay of any
+    surviving node must stay bit-identical."""
+    evs: List[ChaosEvent] = [
+        ChaosEvent(10 + i * every, "propose",
+                   {"node": entry, "group": group,
+                    "payload": f"PUT rk{i} rv{i}-" + "x" * 512})
+        for i in range(n_writes)
+    ]
+    evs.append(ChaosEvent(crash_at, "crash",
+                          {"node": victim, "detect_after": detect_after}))
+    evs.append(ChaosEvent(recover_at, "recover", {"node": victim}))
+    return ChaosSchedule("ring_crash", evs, seed=seed)

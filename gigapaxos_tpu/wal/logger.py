@@ -39,6 +39,7 @@ import numpy as np
 from . import records
 from .journal import JournalCorruptError, scan_journal
 from ..obs.metrics import registry as _obs_registry
+from ..paxos.paystore import DEDUP_MIN_BYTES, payload_digest
 from ..paxos.state import PaxosState
 
 #: fsyncs slower than this count as stalls (the cloud-variance signal).
@@ -55,6 +56,20 @@ _FREE_CHECK_EVERY = 32  # statvfs on every Nth fsync, not every one
 
 SNAP_MAGIC = b"GPTPUS01"
 _SNAP_FTR = struct.Struct("<II")  # crc32(blob), len(blob); then SNAP_MAGIC
+
+#: payload-slot marker for journal dedup: a body already journaled in this
+#: checkpoint epoch is re-referenced as ``(_PAYREF, digest)`` instead of
+#: carrying its bytes again.  Real payloads are always ``bytes``, so the
+#: tuple is unambiguous; old journals (raw bodies only) decode unchanged.
+_PAYREF = "\x00payref"
+
+
+def _payref(digest: bytes) -> tuple:
+    return (_PAYREF, digest)
+
+
+def _is_payref(pl) -> bool:
+    return isinstance(pl, tuple) and len(pl) == 2 and pl[0] == _PAYREF
 
 
 class WalError(RuntimeError):
@@ -210,7 +225,8 @@ class PaxosLogger:
     def __init__(self, log_dir: str, sync_every_ticks: int = 1,
                  checkpoint_every_ticks: int = 1024, native: bool = True,
                  snapshot_keep: int = SNAPSHOT_KEEP,
-                 min_free_bytes: int = MIN_FREE_BYTES):
+                 min_free_bytes: int = MIN_FREE_BYTES,
+                 payload_dedup: bool = True):
         self.dir = log_dir
         os.makedirs(log_dir, exist_ok=True)
         self.sync_every = max(1, sync_every_ticks)
@@ -221,6 +237,13 @@ class PaxosLogger:
         self.journal = None
         self._ticks_since_sync = 0
         self._ticks_since_ckpt = 0
+        #: journal payload dedup (cfg.paxos.wal_payload_dedup): once a
+        #: body's bytes are journaled, later occurrences in the same
+        #: checkpoint epoch append an 8-byte digest reference.  Starts
+        #: empty on every (re)start — a fresh logger over an existing
+        #: journal conservatively writes raw again.
+        self.payload_dedup = bool(payload_dedup)
+        self._pay_seen: set = set()
         self.snapshot_keep = max(1, snapshot_keep)
         self.min_free_bytes = max(0, min_free_bytes)
         #: append/fsync raised OSError: sticky — the node must fail-stop
@@ -403,6 +426,22 @@ class PaxosLogger:
             (OP_SYNC, r, name, donor, donor_exec, donor_status, ckpt)
         ))
 
+    def _ref_payload(self, pl):
+        """Journal-side payload dedup: the first time a body is journaled
+        in this checkpoint epoch its raw bytes go out; every later
+        occurrence becomes an 8-byte ``(_PAYREF, digest)`` marker that
+        replay resolves from the earlier record in the same journal.  The
+        seen-set resets (empty) with every journal roll, keeping each
+        journal a self-contained epoch — see checkpoint()."""
+        if (not self.payload_dedup or not isinstance(pl, bytes)
+                or len(pl) < DEDUP_MIN_BYTES):
+            return pl
+        d = payload_digest(pl)
+        if d in self._pay_seen:
+            return _payref(d)
+        self._pay_seen.add(d)
+        return pl
+
     def log_inbox(self, tick_num: int, inbox) -> None:
         """Called by the manager after `_build_inbox`, before running the
         tick: record exactly what was placed, with payloads for replay."""
@@ -414,7 +453,8 @@ class PaxosLogger:
                 rec = m.outstanding.get(rid)
                 if rec is None:
                     continue
-                entries.append((rid, entry, p, rec.payload, rec.stop))
+                entries.append((rid, entry, p,
+                                self._ref_payload(rec.payload), rec.stop))
             if entries:
                 placed_with_payloads.append((row, entries))
         bulk = None
@@ -422,7 +462,7 @@ class PaxosLogger:
         if bp is not None:
             rids, be, bpp, br = bp
             idx = m.bulk.idx_of(rids)
-            payloads = m.bulk.payload[idx]
+            payloads = [self._ref_payload(pl) for pl in m.bulk.payload[idx]]
             bulk = (
                 rids.astype(np.int64).tobytes(),
                 be.astype(np.int32).tobytes(),
@@ -550,6 +590,14 @@ class PaxosLogger:
             for f in m.kv._fields:
                 state_np["dkv_" + f] = np.asarray(getattr(m.kv, f))
         meta = self._meta(m)
+        # Reset the dedup epoch with the journal roll: each journal is
+        # self-contained (every payref resolves to a raw body earlier in
+        # the SAME file), so replay stays correct even when recovery falls
+        # back a snapshot generation (snapshot_keep) — a seed derived from
+        # THIS snapshot would dangle under that fallback, because a body
+        # admitted since the last checkpoint but placed after this one is
+        # carried nowhere else.
+        self._pay_seen = set()
         buf = io.BytesIO()
         np.savez_compressed(buf, **state_np)
         blob = records.dumps((meta, buf.getvalue()))
@@ -687,6 +735,39 @@ def _tolerate_or_raise(path: str, idx: int, scan, newest: bool, exc) -> bool:
         "refusing to silently skip it.") from exc
 
 
+def _resolve_tick_payrefs(rec, pay_tab: dict):
+    """Undo journal payload dedup on a decoded OP_TICK record: harvest raw
+    bodies into ``pay_tab`` and swap ``(_PAYREF, digest)`` markers for the
+    bodies they reference.  Runs on EVERY OP_TICK — including ticks the
+    replay loop will skip as inside the snapshot — because a later record
+    may reference a body first journaled in a skipped tick.  Ordering
+    matches the writer (placed entries, then the bulk list).  An
+    unresolvable reference raises ValueError so the caller's corrupt-record
+    policy (_tolerate_or_raise) applies."""
+
+    def _resolve(pl):
+        if _is_payref(pl):
+            body = pay_tab.get(pl[1])
+            if body is None:
+                raise ValueError(
+                    f"dangling payload reference {pl[1].hex()}")
+            return body
+        if isinstance(pl, bytes) and len(pl) >= DEDUP_MIN_BYTES:
+            pay_tab[payload_digest(pl)] = pl
+        return pl
+
+    lst = list(rec)
+    lst[2] = [
+        (row, [(rid, entry, p, _resolve(payload), stop)
+               for rid, entry, p, payload, stop in entries])
+        for row, entries in rec[2]
+    ]
+    if len(lst) > 4 and lst[4] is not None:
+        bulk = lst[4]
+        lst[4] = tuple(bulk[:5]) + ([_resolve(pl) for pl in bulk[5]],)
+    return tuple(lst)
+
+
 def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
                     build_inbox, tick_fn, bulk_replay=None):
     """Shared journal-replay loop (passes 2–3 of recovery) for any manager.
@@ -701,6 +782,10 @@ def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
     """
     import collections
 
+    # payref resolution table: each journal is a self-contained dedup epoch
+    # (writer resets _pay_seen at every roll), so an empty table fills in
+    # from raw bodies as records — including snapshot-skipped ticks — decode
+    pay_tab: dict = {}
     paths = sorted(glob.glob(os.path.join(log_dir, "journal.*.log")))
     for path in paths:
         seq = int(os.path.basename(path).split(".")[1])
@@ -711,6 +796,8 @@ def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
         for idx, raw in enumerate(scan.records):
             try:
                 rec = _load_op(raw, OP_SCHEMA)
+                if rec[0] == OP_TICK:
+                    rec = _resolve_tick_payrefs(rec, pay_tab)
             except (ValueError, IndexError) as e:
                 if _tolerate_or_raise(path, idx, scan, newest, e):
                     break
@@ -807,7 +894,10 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
     from ..paxos.manager import PaxosManager, RequestRecord
     from ..ops.tick import TickInbox, paxos_tick_packed, unpack_outbox
 
-    logger = PaxosLogger(log_dir, native=native)
+    logger = PaxosLogger(
+        log_dir, native=native,
+        payload_dedup=getattr(cfg.paxos, "wal_payload_dedup", True),
+    )
     m = PaxosManager(cfg, n_replicas, apps, spill_ns=spill_ns)
     # stale pre-crash spill files must never pre-populate the pause store:
     # they would make OP_CREATE replay return False and desync the row

@@ -140,3 +140,46 @@ def test_digest_survives_crash_recovery(tmp_path):
         assert cl.commit("N1", "svc", b"PUT post 2") == b"OK"
     finally:
         cl.close()
+
+
+def test_digest_default_at_scale_with_ring_relay():
+    """`digest_min_replicas` flips digest ordering on by DEFAULT once the
+    universe reaches 5 replicas (no explicit digest_accepts), and payload
+    bytes then ride the dissemination ring: every write converges while
+    relay slabs — not broadcast frames — carry the bodies."""
+    from gigapaxos_tpu.modeb import ModeBNode
+    from gigapaxos_tpu.models.replicable import KVApp
+    from gigapaxos_tpu.testing.simnet import SimNet
+
+    ids = [f"N{i}" for i in range(5)]
+    net = SimNet(seed=3)
+    cfg = make_cfg(window=4)
+    assert not cfg.paxos.digest_accepts            # not explicitly on
+    assert cfg.paxos.digest_min_replicas == 5      # scale threshold
+    apps = {n: KVApp() for n in ids}
+    nodes = {n: ModeBNode(cfg, ids, n, apps[n], net.messenger(n),
+                          anti_entropy_every=8) for n in ids}
+    for nd in nodes.values():
+        assert nd._digest_accepts and nd._ring_dissemination
+        nd.create_group("svc", [0, 1, 2, 3, 4])
+
+    done = []
+    payload_tail = "y" * 600
+    for i in range(8):
+        nodes["N2"].propose(
+            "svc", f"PUT k{i} v{i}-{payload_tail}".encode(),
+            lambda _rid, resp: done.append(resp))
+        for _ in range(4):
+            for nd in nodes.values():
+                nd.tick()
+            net.pump()
+    for _ in range(30):
+        for nd in nodes.values():
+            nd.tick()
+        net.pump()
+    assert done and all(r == b"OK" for r in done), done
+    dbs = [apps[n].db.get("svc", {}) for n in ids]
+    assert all(d == dbs[0] for d in dbs), dbs
+    assert len(dbs[0]) == 8
+    relayed = sum(nd.stats["relay_payloads"] for nd in nodes.values())
+    assert relayed > 0, {n: dict(nd.stats) for n, nd in nodes.items()}

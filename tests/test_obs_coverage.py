@@ -95,6 +95,10 @@ WIRING = {
     "wal_appended_bytes_total": "gigapaxos_tpu/wal/logger.py",
     "wal_checkpoint_seconds": "gigapaxos_tpu/wal/logger.py",
     "transport_writev_batch_frames": "gigapaxos_tpu/net/transport.py",
+    # ordering/dissemination split (ISSUE 12): coordinator egress economics
+    # and ring-hop latency live in the Mode B manager
+    "egress_bytes_per_decision": "gigapaxos_tpu/modeb/manager.py",
+    "ring_hop_seconds": "gigapaxos_tpu/modeb/manager.py",
     "client_commit_latency_seconds": "gigapaxos_tpu/client.py",
     "client_batch_rtt_seconds": "gigapaxos_tpu/client.py",
     "commit_latency_seconds":
@@ -111,8 +115,12 @@ WIRING = {
 def test_documented_metric_families_exist_at_their_sites():
     for name, rel in WIRING.items():
         assert f'"{name}"' in _src(rel), f"{name} not wired in {rel}"
-    # transport mirrors its stats counters into transport_<key>_total
+    # transport mirrors its stats counters into transport_<key>_total, and
+    # per-peer byte accounting (the once-per-peer-link verification
+    # instrument) into transport_peer_<key>_total
     assert 'f"transport_{key}_total"' in _src("gigapaxos_tpu/net/transport.py")
+    assert 'f"transport_peer_{key}_total"' in _src(
+        "gigapaxos_tpu/net/transport.py")
 
 
 def test_scrape_surfaces_are_wired():

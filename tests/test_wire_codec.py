@@ -121,3 +121,78 @@ def test_batch_container_rejects_truncation():
         wire.decode_frames(buf[:-1])
     with pytest.raises(ValueError):
         wire.decode_frames(buf + b"x")
+
+
+# ---------------------------------------------------------- relay slabs
+def random_relay_items(rng, n=None):
+    n = int(rng.integers(0, 24)) if n is None else n
+    return [(int(rng.integers(-1 << 31, 1 << 31)),
+             bool(rng.random() < 0.5),
+             rng.bytes(int(rng.integers(0, 96))))  # zero-length included
+            for _ in range(n)]
+
+
+def assert_slab_items(slab, items, sender_r=None, tick=None):
+    if sender_r is not None:
+        assert slab.sender_r == sender_r
+    if tick is not None:
+        assert slab.tick == tick
+    assert slab.items() == items
+
+
+def test_relay_roundtrip_randomized():
+    """encode_relay/decode_relay is exact over randomized multi-group
+    slabs (own items + forwarded groups concatenated into one frame)."""
+    rng = np.random.default_rng(2024)
+    for _ in range(40):
+        groups, flat = [], []
+        for _g in range(int(rng.integers(1, 4))):
+            items = random_relay_items(rng)
+            flat.extend(items)
+            groups.append(wire.relay_group(items))
+        sr, tick = int(rng.integers(0, 8)), int(rng.integers(0, 1 << 40))
+        buf = wire.encode_relay(sr, tick, 123.5, groups)
+        assert buf[:4] == wire.RELAY_MAGIC
+        slab = wire.decode_relay(buf)
+        assert slab.sent_s == 123.5
+        assert_slab_items(slab, flat, sender_r=sr, tick=tick)
+
+
+def test_relay_slab_keep_slices_and_reoffsets():
+    """The forward-hop property: slab_keep under a random mask, re-encoded
+    and re-decoded, yields exactly the kept items — the slice-and-forward
+    path never decodes or copies per record, so the re-offset math must be
+    exact including runs of adjacent keeps (coalesced blob slices)."""
+    rng = np.random.default_rng(77)
+    for _ in range(40):
+        items = random_relay_items(rng, n=int(rng.integers(1, 24)))
+        slab = wire.decode_relay(
+            wire.encode_relay(2, 9, 0.0, [wire.relay_group(items)]))
+        keep = rng.random(len(items)) < 0.6
+        kept = [it for it, k in zip(items, keep) if k]
+        group = wire.slab_keep(slab, keep)
+        buf2 = wire.encode_relay(3, 10, 0.0, [group])
+        assert_slab_items(wire.decode_relay(buf2), kept)
+
+
+def test_relay_rejects_bad_magic_version_truncation():
+    items = random_relay_items(np.random.default_rng(8), n=5)
+    buf = wire.encode_relay(1, 2, 0.0, [wire.relay_group(items)])
+    with pytest.raises(ValueError):
+        wire.decode_relay(b"XXXX" + buf[4:])
+    bad_ver = bytearray(buf)
+    struct.pack_into("<H", bad_ver, 4, 99)
+    with pytest.raises(ValueError):
+        wire.decode_relay(bytes(bad_ver))
+    with pytest.raises((ValueError, struct.error)):
+        wire.decode_relay(buf[:-1])
+
+
+def test_relay_magic_distinct_from_other_protocols():
+    """The transport raw-bytes channel demuxes by 4-byte magic; the relay
+    slab must never collide with the frame/batch/binbatch kinds."""
+    from gigapaxos_tpu.net import binbatch
+
+    magics = {wire.MAGIC, wire.BATCH_MAGIC, wire.RELAY_MAGIC,
+              binbatch.REQ_MAGIC, binbatch.REQ2_MAGIC, binbatch.RESP_MAGIC}
+    assert len(magics) == 6

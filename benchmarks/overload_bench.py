@@ -1,0 +1,231 @@
+"""Overload bench: open-loop ramp through and past the capacity knee.
+
+The overload plane's acceptance artifact (ISSUE 14).  An
+:class:`~gigapaxos_tpu.testing.openloop.OpenLoopGenerator` drives a
+simulated client population (arrivals clock-scheduled, never waiting on
+completions) against a real loopback cluster — client edge, ActiveReplica
+ingress, Mode A manager, real sockets — ramping offered load multiplicatively
+until a rung fails, then holding a rung at 2x the measured knee.  Gates:
+
+* ``goodput at 2x knee >= 0.8 x peak goodput`` — admission control keeps
+  the system on the flat of its throughput curve instead of collapsing;
+* ``zero control-class sheds while client-class sheds are active`` — the
+  classed budgets protect liveness traffic;
+* ``p99 of ADMITTED work at 2x knee <= wire deadline`` — work the system
+  accepts finishes inside the deadline; dead work is refused, not served
+  late (goodput counts only in-window completions, so deadline-expired
+  silent drops can never inflate it);
+* the **overload + crash chaos leg** — a client-class flood past the
+  watermark with a coordinator crash/re-election in the middle (PR 6
+  harness) must shed visibly AND keep the per-slot S1 ledger clean.
+
+Run: ``python benchmarks/overload_bench.py [--smoke] [--json PATH]``.
+Prints one JSON line per rung plus a final summary line with
+``gate_pass``; ``benchmarks/run_artifacts.py`` refreshes the committed
+``results_overload_pr14.json`` from it and raises on a failed gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_ramp(init_load: float, duration_s: float, deadline_s: float,
+             intake_hi: int, n_groups: int, max_rungs: int = 12,
+             factor: float = 1.5) -> dict:
+    """Walk the open-loop ladder to the knee, then hold 2x knee."""
+    from gigapaxos_tpu.overload import CLS_NAMES  # noqa: F401 (doc link)
+    from gigapaxos_tpu.testing.openloop import (OpenLoopGenerator, find_knee,
+                                                make_overload_cluster,
+                                                shed_totals, expired_totals)
+
+    sheds0 = shed_totals()
+    cluster, client = make_overload_cluster(
+        n_groups=n_groups, intake_hi=intake_hi)
+    try:
+        gen = OpenLoopGenerator(client, [f"g{i}" for i in range(n_groups)],
+                                deadline_s=deadline_s)
+        think_s = 1.0  # population == offered rps; think time held at 1 s
+        rungs = []
+        load = init_load
+        for _ in range(max_rungs):
+            r = gen.run_rung(int(load), think_s, duration_s)
+            rungs.append(r)
+            print(json.dumps(r.to_dict()), file=sys.stderr)
+            if not r.passed():
+                break
+            load *= factor
+        knee = find_knee(rungs)
+        knee_rps = knee.offered_rps if knee else rungs[0].offered_rps
+        over = gen.run_rung(int(2 * knee_rps), think_s, duration_s)
+        print(json.dumps({"rung_2x_knee": over.to_dict()}), file=sys.stderr)
+        sheds1 = shed_totals()
+        peak = max(r.goodput_rps for r in rungs + [over])
+        client_sheds = sheds1.get("client", 0) - sheds0.get("client", 0)
+        control_sheds = sheds1.get("control", 0) - sheds0.get("control", 0)
+        return {
+            "rungs": [r.to_dict() for r in rungs],
+            "rung_2x_knee": over.to_dict(),
+            "knee_rps": round(knee_rps, 1),
+            "peak_goodput_rps": round(peak, 1),
+            "goodput_2x_knee_rps": round(over.goodput_rps, 1),
+            "goodput_2x_knee_frac_of_peak": round(
+                over.goodput_rps / peak, 3) if peak else 0.0,
+            "p99_admitted_2x_knee_ms": round(over.p99_s() * 1e3, 2),
+            "deadline_ms": round(deadline_s * 1e3, 1),
+            "client_sheds": client_sheds,
+            "control_sheds": control_sheds,
+            "shed_busy_2x_knee": over.shed_busy,
+            "expired_by_stage": expired_totals(),
+        }
+    finally:
+        client.close()
+        cluster.close()
+
+
+def run_chaos_leg(flood_per_tick: int, ticks: int, intake_hi: int) -> dict:
+    """Client-class flood past the watermark + coordinator crash: the
+    plane must shed (visibly, with busy NACKs) and the per-slot S1 safety
+    ledger must stay empty throughout the brownout and re-election."""
+    from gigapaxos_tpu.config import GigapaxosTpuConfig
+    from gigapaxos_tpu.models.replicable import KVApp
+    from gigapaxos_tpu.modeb import ModeBNode
+    from gigapaxos_tpu.overload import CLS_CLIENT, RID_BUSY
+    from gigapaxos_tpu.testing.chaos import (ChaosSchedule, SimChaosRunner,
+                                             coordinator_crash)
+    from gigapaxos_tpu.testing.simnet import SimNet
+
+    ids = ["N0", "N1", "N2"]
+    net = SimNet(seed=14)
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 8
+    cfg.overload.enabled = True
+    cfg.overload.intake_hi = intake_hi
+    cfg.overload.intake_lo = max(1, intake_hi // 4)
+    nodes = {n: ModeBNode(cfg, ids, n, KVApp(), net.messenger(n),
+                          anti_entropy_every=8) for n in ids}
+    for nd in nodes.values():
+        nd.create_group("svc", [0, 1, 2])
+    sched = coordinator_crash("N0", crash_at=ticks // 4,
+                              recover_at=ticks // 2, detect_after=4)
+    runner = SimChaosRunner(net, nodes, sched)
+    counts = {"sent": 0, "ok": 0, "busy": 0, "failed": 0}
+
+    def cb(rid, resp):
+        if rid == RID_BUSY or (rid is None):
+            counts["busy"] += 1
+        elif resp is None:
+            counts["failed"] += 1
+        else:
+            counts["ok"] += 1
+
+    flood_until = int(ticks * 0.7)
+
+    def on_tick(t):
+        if t >= flood_until:
+            return
+        entry = "N1" if "N0" in runner.crashed else "N0"
+        for i in range(flood_per_tick):
+            counts["sent"] += 1
+            rid = nodes[entry].propose(
+                "svc", f"PUT k{i % 7} t{t}i{i}".encode(), cb,
+                cls=CLS_CLIENT)
+            if rid == RID_BUSY:
+                pass  # counted by the held-callback flush
+
+    runner.run(ticks, on_tick=on_tick)
+    runner.ledger.assert_safe()
+    shed_stats = sum(nd.stats.get("shed_requests", 0)
+                     for nd in runner.nodes.values())
+    return {
+        "ticks": ticks,
+        "flood_per_tick": flood_per_tick,
+        "intake_hi": intake_hi,
+        "sent": counts["sent"],
+        "committed": counts["ok"],
+        "busy_nacks": counts["busy"],
+        "failed": counts["failed"],
+        "node_shed_requests": shed_stats,
+        "s1_violations": len(runner.ledger.violations),
+        "s1_observations": runner.ledger.observations,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 sizing: tiny cluster, ~2 s ramp")
+    ap.add_argument("--json", default=None,
+                    help="also write the summary to this path")
+    ap.add_argument("--platform", default="cpu")
+    ap.add_argument("--init-load", type=float, default=None)
+    ap.add_argument("--duration", type=float, default=None)
+    ap.add_argument("--deadline-s", type=float, default=2.0)
+    ap.add_argument("--intake-hi", type=int, default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    if args.smoke:
+        init, dur, hi, groups, rungs = args.init_load or 300.0, 1.0, 64, 2, 8
+        chaos = dict(flood_per_tick=24, ticks=80, intake_hi=24)
+    else:
+        # 2 groups on purpose: the knee must sit well under what one
+        # generator thread can offer, or the 2x-knee rung measures the
+        # harness instead of the admission plane
+        init, dur, hi, groups, rungs = args.init_load or 300.0, 2.0, 64, 2, 12
+        chaos = dict(flood_per_tick=48, ticks=240, intake_hi=48)
+    if args.duration:
+        dur = args.duration
+    if args.intake_hi:
+        hi = args.intake_hi
+
+    t0 = time.monotonic()
+    ramp = run_ramp(init, dur, args.deadline_s, hi, groups, max_rungs=rungs)
+    leg = run_chaos_leg(**chaos)
+
+    gates = {
+        "goodput_2x_knee_ge_80pct_peak":
+            ramp["goodput_2x_knee_frac_of_peak"] >= 0.8,
+        "client_sheds_active": ramp["client_sheds"] > 0,
+        "zero_control_sheds": ramp["control_sheds"] == 0,
+        # 10% slack: the egress cutoff fires at the AR before the send, so
+        # an admitted response can land a network hop after the deadline
+        "p99_admitted_2x_knee_le_deadline":
+            ramp["p99_admitted_2x_knee_ms"] <= 1.1 * ramp["deadline_ms"],
+        "chaos_sheds_visible": leg["busy_nacks"] > 0,
+        "chaos_zero_s1_violations": leg["s1_violations"] == 0,
+        "chaos_commits_under_flood": leg["committed"] > 0,
+    }
+    out = {
+        "metric": "overload_goodput_2x_knee_frac_of_peak",
+        "value": ramp["goodput_2x_knee_frac_of_peak"],
+        "unit": "ratio (>= 0.8 gates)",
+        "smoke": bool(args.smoke),
+        "elapsed_s": round(time.monotonic() - t0, 1),
+        "ramp": ramp,
+        "overload_crash_leg": leg,
+        "gates": gates,
+        "gate_pass": all(gates.values()),
+    }
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1)
+        out["written"] = args.json
+    print(json.dumps(out))
+    if not out["gate_pass"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

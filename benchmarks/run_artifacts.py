@@ -346,6 +346,33 @@ def bench_storage_faults() -> dict:
     }
 
 
+def bench_overload() -> dict:
+    """Overload plane gate (benchmarks/overload_bench.py): refreshes
+    results_overload_pr14.json — open-loop ramp through and past the
+    capacity knee plus the overload+crash chaos leg.  Hard gates: goodput
+    at 2x knee >= 80% of peak, zero control-class sheds while client-class
+    sheds are active, p99 of admitted work bounded by the wire deadline,
+    zero S1 violations while shedding through a coordinator crash."""
+    r = _script(["benchmarks/overload_bench.py", "--json",
+                 "benchmarks/results_overload_pr14.json"], timeout=3600)[-1]
+    if not r["gate_pass"]:
+        raise RuntimeError(f"overload gates failed: {r['gates']}")
+    ramp = r["ramp"]
+    return {
+        "metric": r["metric"],
+        "value": r["value"],
+        "unit": r["unit"],
+        "knee_rps": ramp["knee_rps"],
+        "goodput_2x_knee_rps": ramp["goodput_2x_knee_rps"],
+        "p99_admitted_2x_knee_ms": ramp["p99_admitted_2x_knee_ms"],
+        "client_sheds": ramp["client_sheds"],
+        "control_sheds": ramp["control_sheds"],
+        "chaos_busy_nacks": r["overload_crash_leg"]["busy_nacks"],
+        "chaos_s1_violations": r["overload_crash_leg"]["s1_violations"],
+        "artifact": r.get("written"),
+    }
+
+
 def bench_cells_capacity() -> dict:
     """Serving-cells capacity sweep (benchmarks/cells_capacity.py):
     refreshes results_capacity_cells_pr8.json (1 -> 2 -> 4 cells with
@@ -429,6 +456,8 @@ def main() -> None:
     run("storage_faults", bench_storage_faults)
     # ordering/dissemination split (PR 12): flat coordinator egress gate
     run("egress", bench_egress)
+    # overload plane (PR 14): knee ramp + classed-shed + deadline gates
+    run("overload", bench_overload)
 
     out = args.out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),

@@ -102,6 +102,7 @@ def main() -> None:
     if spec.get("ledger"):
         _install_ledger()
 
+    from gigapaxos_tpu import overload as _overload
     from gigapaxos_tpu.config import GigapaxosTpuConfig
     from gigapaxos_tpu.models.replicable import KVApp
     from gigapaxos_tpu.net.failure_detection import FailureDetection
@@ -213,6 +214,11 @@ def main() -> None:
 
         def on_edge_request(sender: str, p: dict) -> None:
             name = p.get("name", "")
+            if _overload.expired(p.get("deadline")):
+                # dead on arrival at the edge: don't burn a cross-cell
+                # forward (or an owner-cell propose) on abandoned work
+                _overload.count_expired("edge_forward", f"c{cell}")
+                return
             owner = overrides.get(name)
             if owner is None:
                 owner = cell_of(name, n_cells)
@@ -224,7 +230,7 @@ def main() -> None:
                 if tid is not None:
                     xt.event(tid, "edge_forward", src=cell, dst=owner,
                              name=name)
-                edge_m.send(f"c{owner}.AR0", p)
+                edge_m.send(f"c{owner}.AR0", p, cls=_overload.CLS_CLIENT)
 
         edge_m.register(pkt.APP_REQUEST, on_edge_request)
 

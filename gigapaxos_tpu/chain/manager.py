@@ -30,6 +30,7 @@ import numpy as np
 from ..config import GigapaxosTpuConfig
 from ..models.replicable import Replicable
 from ..types import NO_REQUEST
+from .. import overload as _overload
 from ..utils.intmap import RowAllocator
 from ..obs.phase import phase_clock as _phase_clock
 from ..utils.locking import ContendedLock, locked as _locked
@@ -78,6 +79,11 @@ class ChainManager:
         self._held_callbacks: list = []
         self.stats = collections.Counter()
         self._stopped_rows: set[int] = set()
+        # intake governor: watermark shed of client-class proposes (ISSUE 14)
+        self.overload = (
+            _overload.IntakeGovernor(cfg.overload.intake_hi,
+                                     cfg.overload.intake_lo, node="chain")
+            if cfg.overload.enabled else None)
         # host mirrors of config state (see paxos/manager.py rationale)
         self._member_np = np.zeros((self.R, self.G), bool)
         self._n_members_np = np.zeros(self.G, np.int32)
@@ -157,10 +163,27 @@ class ChainManager:
         callback: Optional[Callable[[int, bytes], None]] = None,
         stop: bool = False,
         entry: Optional[int] = None,
+        deadline: Optional[int] = None,
+        cls: int = _overload.CLS_CONTROL,
     ) -> Optional[int]:
         """Order one write through the chain's head (``propose :434``).
         ``entry`` is accepted for SPI compatibility and ignored — the head
         is always the entry."""
+        if _overload.expired(deadline):
+            if callback is not None:
+                self._held_callbacks.append(
+                    (callback, _overload.RID_EXPIRED, None))
+            self.stats["expired_drops"] += 1
+            _overload.count_expired("intake", "chain")
+            return None
+        if (cls == _overload.CLS_CLIENT and self.overload is not None
+                and not self.overload.admit(cls)):
+            if callback is not None:
+                self._held_callbacks.append(
+                    (callback, _overload.RID_BUSY, None))
+            self.stats["shed_requests"] += 1
+            _overload.count_shed(cls, "intake", "chain")
+            return None
         row = self.rows.row(name)
         if row is None:
             return None
@@ -217,6 +240,11 @@ class ChainManager:
     def tick(self) -> HostChainOutbox:
         pc = self._pc
         pc.begin()
+        if self.overload is not None:
+            self.overload.update(
+                sum(len(q) for q in self._queues.values())
+                + sum(1 for rec in self.outstanding.values()
+                      if not rec.responded))
         inbox = self._build_inbox()
         pc.mark("intake")
         # dispatch first, journal second: the WAL fsync overlaps the async

@@ -46,6 +46,7 @@ import numpy as np
 from ..config import GigapaxosTpuConfig
 from ..models.replicable import Replicable
 from ..modeb import wire
+from .. import overload as _overload
 from ..modeb.common import RID_MASK, RID_SHIFT, ModeBCommon  # noqa: F401
 from ..net.messenger import Messenger
 from ..net.transport import SendFailure
@@ -280,6 +281,12 @@ class ChainModeBNode(ModeBCommon):
         self._frame_applied_tick: Dict[int, int] = {}
         self._last_frame_rx = 0
         self.stats = collections.Counter()
+        # intake governor: watermark shed of client-class proposes (ISSUE 14)
+        self._ov_node = node_id
+        self.overload = (
+            _overload.IntakeGovernor(cfg.overload.intake_hi,
+                                     cfg.overload.intake_lo, node=node_id)
+            if cfg.overload.enabled else None)
         self.lock = ContendedLock()
         self._tick_packed = chain_node_tick_packed(self.r)
         self._in_req = np.zeros((self.P, self.G), np.int32)
@@ -407,7 +414,8 @@ class ChainModeBNode(ModeBCommon):
     # ---------------------------------------------------------------- propose
     def propose(self, name: str, payload: bytes,
                 callback: Optional[Callable[[int, Optional[bytes]], None]] = None,
-                stop: bool = False) -> Optional[int]:
+                stop: bool = False, deadline: Optional[int] = None,
+                cls: int = _overload.CLS_CONTROL) -> Optional[int]:
         """Lock-free fast path like the paxos planes (see
         paxos/manager.propose): stage for the next tick's drain; the
         existence/fenced pre-checks are racy reads and the authoritative
@@ -415,6 +423,16 @@ class ChainModeBNode(ModeBCommon):
         re-checks under the lock before rejecting — a recycled row can be
         visible in the row table before the old occupant's stopped flag is
         discarded."""
+        if (cls == _overload.CLS_CLIENT and self.overload is not None
+                and not self.overload.admit(cls)):
+            # watermark shed: explicit retriable busy NACK, never silent
+            self.stats["shed_requests"] += 1
+            _overload.count_shed(cls, "intake", self._ov_node)
+            with self.lock:
+                if callback is not None:
+                    self._held_callbacks.append(
+                        (callback, _overload.RID_BUSY, None))
+            return None
         row = self.rows.row(name)  # racy read: benign for the POSITIVE case
         if row is None or row in self._stopped_rows:
             with self.lock:
@@ -424,7 +442,7 @@ class ChainModeBNode(ModeBCommon):
                         self._held_callbacks.append((callback, -1, None))
                     return None
         rid = self.next_rid()
-        self._staged.append((rid, name, payload, callback, stop))
+        self._staged.append((rid, name, payload, callback, stop, deadline))
         if self.reqtrace.enabled:
             self.reqtrace.event(rid, "staged", name=name, node=self.node_id)
         self._wake()
@@ -436,9 +454,17 @@ class ChainModeBNode(ModeBCommon):
         already forwards every queued rid to a remote head."""
         while True:
             try:
-                rid, name, payload, callback, stop = self._staged.popleft()
+                (rid, name, payload, callback, stop,
+                 deadline) = self._staged.popleft()
             except IndexError:
                 return
+            if _overload.expired(deadline):
+                if callback is not None:
+                    self._held_callbacks.append(
+                        (callback, _overload.RID_EXPIRED, None))
+                self.stats["expired_drops"] += 1
+                _overload.count_expired("intake", self._ov_node)
+                continue
             row = self.rows.row(name)
             if row is None or row in self._stopped_rows:
                 if callback is not None:
@@ -487,6 +513,13 @@ class ChainModeBNode(ModeBCommon):
     def tick(self):
         pc = self._pc
         pc.begin()
+        if self.overload is not None:
+            with self.lock:
+                backlog = (len(self._staged)
+                           + sum(len(q) for q in self._queues.values())
+                           + sum(1 for rec in self.outstanding.values()
+                                 if not rec.responded))
+            self.overload.update(backlog)
         with self.lock:
             self._refresh_alive()
             self._flush_mirrors()

@@ -28,6 +28,7 @@ import time
 import uuid
 from typing import Callable, Dict, List, Optional, Tuple
 
+from . import overload as _overload
 from .config import NodeConfig
 from .net import binbatch
 from .net.messenger import Messenger, NodeMap
@@ -52,6 +53,10 @@ class ReconfigurableAppClient:
         security=None,
         placement_table=None,
         trace_wire: "bool | None" = None,
+        retry_fraction: float = 0.1,
+        breaker_threshold: int = 5,
+        breaker_cooloff_s: float = 1.0,
+        default_deadline_s: float = 15.0,
     ):
         """``security``: a ``TransportSecurity`` for TLS deployments — under
         MUTUAL_AUTH it must carry a CA-signed client certificate (the
@@ -135,9 +140,30 @@ class ReconfigurableAppClient:
         self._trace_ids: "collections.OrderedDict[int, int]" = (
             collections.OrderedDict()
         )
+        # ---- overload plane (ISSUE 14): storm dampers + wire deadlines ----
+        #: retry budget: retries spend from a bucket funded at
+        #: ``retry_fraction`` per fresh request — a brownout triggers at
+        #: most ~10% retry amplification instead of tries× (SRE retry
+        #: budget; the transport's own frame retries are unaffected)
+        self.retry_budget = _overload.TokenBucket(fraction=retry_fraction)
+        #: per-active circuit breakers driven by NACK/timeout rate; consulted
+        #: non-consumingly by the redirector so a browned-out destination is
+        #: avoided for a cooloff instead of hammered
+        self._breakers: Dict[str, _overload.CircuitBreaker] = {}
+        self._breaker_threshold = int(breaker_threshold)
+        self._breaker_cooloff_s = float(breaker_cooloff_s)
+        #: default wire deadline for async sends (sync paths derive the
+        #: deadline from their own timeout argument)
+        self.default_deadline_s = float(default_deadline_s)
 
     def close(self) -> None:
         self.m.close()
+
+    def _wire_deadline(self) -> int:
+        """Default async-path wire deadline; 0 (no deadline) when stamping
+        is disabled with ``default_deadline_s <= 0``."""
+        return (_overload.deadline_at(self.default_deadline_s)
+                if self.default_deadline_s > 0 else 0)
 
     # ------------------------------------------------------------- plumbing
     def _rid(self) -> int:
@@ -191,13 +217,39 @@ class ReconfigurableAppClient:
         if cb is not None:
             cb(p)
 
+    def _breaker(self, target: str) -> _overload.CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(target)
+            if br is None:
+                br = self._breakers[target] = _overload.CircuitBreaker(
+                    threshold=self._breaker_threshold,
+                    cooloff_s=self._breaker_cooloff_s)
+            return br
+
+    def _reap(self, rid: int) -> None:
+        """Drop every per-rid map entry for an abandoned request.  Without
+        this, a sustained-timeout workload (dead active, partitioned
+        client) grows _sent_at/_trace_ids without bound — each timed-out
+        rid's entries survived because only the response path popped them."""
+        with self._lock:
+            self._sent_at.pop(rid, None)
+            self._callbacks.pop(rid, None)
+            self._cb_deadline.pop(rid, None)
+            self._trace_ids.pop(rid, None)
+
     def _await(self, rid: int, timeout: float) -> dict:
         deadline = time.monotonic() + timeout
         with self._lock:
             while rid not in self._results:
                 left = deadline - time.monotonic()
                 if left <= 0:
+                    # reap the rid's tracking entries (not just _sent_at):
+                    # an abandoned rid must not leak its trace-id/callback
+                    # bookkeeping when no response ever arrives
                     self._sent_at.pop(rid, None)
+                    self._callbacks.pop(rid, None)
+                    self._cb_deadline.pop(rid, None)
+                    self._trace_ids.pop(rid, None)
                     raise TimeoutError(f"rid {rid}")
                 self._cv.wait(timeout=left)
             return self._results.pop(rid)
@@ -495,6 +547,13 @@ class ReconfigurableAppClient:
         not_active while still birthing the epoch) — excluded unless that
         empties the pool."""
         pool = [a for a in actives if a not in avoid] or list(actives)
+        # breaker screen (non-consuming): skip destinations in cooloff.
+        # Fail open when every candidate's breaker is open — some target
+        # must carry the probe that lets a breaker half-open and close.
+        with self._lock:
+            live = [a for a in pool
+                    if a not in self._breakers or self._breakers[a].allow()]
+        pool = live or pool
         unknown = [a for a in pool if a not in self._rtt]
         if unknown or random.random() < self.explore_prob:
             return random.choice(unknown or pool)
@@ -522,7 +581,9 @@ class ReconfigurableAppClient:
             self._callbacks[rid] = callback
             self._cb_deadline[rid] = now + self._cb_ttl_s
             self._sent_at[rid] = (target, now)
-        self.m.send(target, self._stamp(pkt.app_request(name, payload, rid)))
+        p = pkt.app_request(name, payload, rid)
+        p["deadline"] = self._wire_deadline()
+        self.m.send(target, self._stamp(p), cls=_overload.CLS_CLIENT)
         return rid
 
     def _batch_rtt(self, bid) -> None:
@@ -614,9 +675,11 @@ class ReconfigurableAppClient:
         ``callback`` gets each raw per-request response packet.  Returns
         the assigned rids in item order."""
         by_target, rids, bid = self._stage_batch(items, callback, active)
+        dl = self._wire_deadline()
         for i, (target, reqs) in enumerate(by_target.items()):
-            self.m.send(target,
-                        self._stamp(pkt.app_request_batch(reqs, bid + i)))
+            p = pkt.app_request_batch(reqs, bid + i)
+            p["deadline"] = dl  # one deadline per frame: shared send instant
+            self.m.send(target, self._stamp(p), cls=_overload.CLS_CLIENT)
         return rids
 
     def request(self, name: str, payload: bytes, timeout: float = 15.0,
@@ -635,11 +698,25 @@ class ReconfigurableAppClient:
         per = max(timeout / tries, 0.5)
         last = "timeout"
         rid = self._rid()  # one rid for every attempt (retransmission dedup)
+        # one wire deadline for the whole request: every attempt carries it,
+        # and any stage that sees it expired drops the work instead of
+        # finishing it for a caller that already gave up
+        wire_deadline = _overload.deadline_at(timeout)
+        self.retry_budget.deposit()  # fresh request funds the retry budget
         bad: set = set()  # targets that failed this request (rotate away:
         # after an epoch change one member may still be birthing the group,
         # and RTT-greedy picking would hammer it until the budget dies)
+        # only overload signals (timeout, busy) spend the retry budget: a
+        # not_active/stopped/wrong_cell redirect is a fast rejection from a
+        # healthy node — chasing a migrated group must not starve the budget
+        charge_retry = False
         try:
             for attempt in range(tries):
+                if (attempt > 0 and charge_retry
+                        and not self.retry_budget.take()):
+                    # budget dry: fail fast rather than amplify a brownout
+                    raise TimeoutError(
+                        f"{name}: retry budget exhausted ({last})")
                 try:
                     actives = self.request_actives(name, force=attempt > 0)
                 except ClientError as e:
@@ -647,22 +724,30 @@ class ReconfigurableAppClient:
                 target = self._route(name, actives, avoid=bad)
                 with self._lock:
                     self._sent_at[rid] = (target, time.monotonic())
-                self.m.send(
-                    target, self._stamp(pkt.app_request(name, payload, rid))
-                )
+                p = pkt.app_request(name, payload, rid)
+                p["deadline"] = wire_deadline
+                self.m.send(target, self._stamp(p), cls=_overload.CLS_CLIENT)
                 try:
                     resp = self._await(rid, per)
                 except TimeoutError:
                     last = f"timeout via {target}"
+                    charge_retry = True
                     self._penalize(target, per)
+                    self._breaker(target).record(False)
                     bad.add(target)
                     self._drop_route(name)
                     self._resolve_backoff_sleep(name)
                     continue
                 if resp.get("ok"):
                     self._resolve_backoff_reset(name)
+                    self._breaker(target).record(True)
                     return pkt.b64d(resp["response"]) or b""
                 last = resp.get("error", "error")
+                # busy = the destination is shedding (overload NACK): a
+                # breaker failure.  Other rejections mean the node is alive
+                # and fast — routing signals, not overload.
+                self._breaker(target).record(last != "busy")
+                charge_retry = last == "busy"
                 if last not in ("not_active", "stopped", "busy",
                                 "wrong_cell"):
                     raise ClientError(f"{name}: {last}")
@@ -677,8 +762,8 @@ class ReconfigurableAppClient:
             # a late response from an earlier attempt's target leaves the
             # newest _sent_at entry unconsumed (sender mismatch keeps it);
             # the sync path owns this rid end-to-end, so always reap it
-            with self._lock:
-                self._sent_at.pop(rid, None)
+            # (trace ids ride the rid too)
+            self._reap(rid)
 
     def _penalize(self, target: str, timeout_s: float) -> None:
         """Feed a timeout into the target's EWMA as a huge latency sample —
@@ -699,32 +784,48 @@ class ReconfigurableAppClient:
         per = max(timeout / tries, 0.5)
         last = "timeout"
         rid = self._rid()
+        wire_deadline = _overload.deadline_at(timeout)
+        self.retry_budget.deposit()
+        charge_retry = False  # same rule as request(): redirects retry free
         try:
             for attempt in range(tries):
+                if (attempt > 0 and charge_retry
+                        and not self.retry_budget.take()):
+                    raise TimeoutError(
+                        f"{name}: retry budget exhausted ({last})")
                 pool = self.request_actives(pkt.ALL_ACTIVES,
                                             force=attempt > 0)
-                target = random.choice(pool)
+                with self._lock:
+                    live = [a for a in pool
+                            if a not in self._breakers
+                            or self._breakers[a].allow()]
+                target = random.choice(live or pool)
                 p = pkt.app_request(name, payload, rid)
                 p["anycast"] = True
+                p["deadline"] = wire_deadline
                 with self._lock:
                     self._sent_at[rid] = (target, time.monotonic())
-                self.m.send(target, self._stamp(p))
+                self.m.send(target, self._stamp(p), cls=_overload.CLS_CLIENT)
                 try:
                     resp = self._await(rid, per)
                 except TimeoutError:
                     last = f"timeout via {target}"
+                    charge_retry = True
                     self._penalize(target, per)
+                    self._breaker(target).record(False)
                     continue
                 if resp.get("ok"):
+                    self._breaker(target).record(True)
                     return pkt.b64d(resp["response"]) or b""
                 last = resp.get("error", "error")
+                self._breaker(target).record(last != "busy")
+                charge_retry = last == "busy"
                 if last not in ("not_active", "stopped", "busy"):
                     raise ClientError(f"{name}: {last}")
                 time.sleep(min(0.1 * (attempt + 1), 0.5))
             raise TimeoutError(f"{name}: {last}")
         finally:
-            with self._lock:
-                self._sent_at.pop(rid, None)
+            self._reap(rid)
 
     def _on_binary_batch_response(self, sender: str, buf: bytes) -> None:
         """Columnar response frame -> per-rid callbacks.  One lock
@@ -761,10 +862,12 @@ class ReconfigurableAppClient:
         frames).  Successful responses carry raw bytes under
         ``response_raw`` (no base64 round-trip)."""
         by_target, rids, bid = self._stage_batch(items, callback, active)
+        dl = self._wire_deadline()
         for i, (target, reqs) in enumerate(by_target.items()):
             self.m.send_bytes(target, binbatch.encode_request(
-                bid + i, self.addr[0], self.addr[1], self.node_id, reqs
-            ))
+                bid + i, self.addr[0], self.addr[1], self.node_id, reqs,
+                deadline=dl,
+            ), cls=_overload.CLS_CLIENT)
         return rids
 
     def batching(self, max_batch: int = 128,

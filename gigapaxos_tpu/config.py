@@ -371,6 +371,66 @@ class ObsConfig:
 
 
 @dataclass
+class OverloadConfig:
+    """Overload-robustness plane (ISSUE 14): classed admission control,
+    deadline propagation, and client-side retry damping.
+
+    Properties keys: ``overload.intake_hi=4096``, env overrides
+    ``GPTPU_OVERLOAD_<FIELD>``.  The invariant: finish or refuse fast,
+    never silently drop or do dead work.
+    """
+
+    # Master switch for the node-side intake governor (deadline drops and
+    # per-class transport budgets are always on — they are pure wins).
+    enabled: bool = True
+    # Watermark-with-hysteresis admission at the node intake, measured in
+    # outstanding client requests (staged + in-flight).  Crossing
+    # ``intake_hi`` starts shedding client-class proposes with a retriable
+    # busy NACK; shedding stops below ``intake_lo`` (0 = intake_hi // 2).
+    intake_hi: int = 4096
+    intake_lo: int = 0
+    # Client retry budget: each fresh request funds ``retry_fraction``
+    # retry tokens (the ~10%% rule); ``retry_initial`` seeds a cold-start
+    # burst, ``retry_cap`` bounds banking.
+    retry_fraction: float = 0.1
+    retry_initial: float = 3.0
+    retry_cap: float = 50.0
+    # Per-destination circuit breaker: trip after ``breaker_threshold``
+    # consecutive NACK/timeout failures (or >= 50%% of a sliding window),
+    # avoid the destination for ``breaker_cooloff_s`` (doubling, capped).
+    breaker_threshold: int = 5
+    breaker_cooloff_s: float = 1.0
+    # Default wire deadline stamped on client requests that give none
+    # (<= 0 disables stamping; explicit per-call deadlines always win).
+    default_deadline_s: float = 15.0
+    # Transport send-queue budget for client-class frames, as a fraction
+    # of ``paxos.send_queue_cap`` (control class keeps the full cap, so
+    # liveness traffic always has headroom a client flood cannot take).
+    client_queue_frac: float = 0.75
+
+    def __post_init__(self) -> None:
+        if self.intake_hi < 2:
+            raise ValueError(
+                f"overload.intake_hi must be >= 2, got {self.intake_hi}")
+        if self.intake_lo and self.intake_lo >= self.intake_hi:
+            raise ValueError(
+                f"overload.intake_lo ({self.intake_lo}) must be < "
+                f"intake_hi ({self.intake_hi}) — the hysteresis band")
+        if not (0.0 < self.retry_fraction <= 1.0):
+            raise ValueError(
+                f"overload.retry_fraction must be in (0, 1], got "
+                f"{self.retry_fraction}")
+        if self.breaker_threshold < 1:
+            raise ValueError(
+                f"overload.breaker_threshold must be >= 1, got "
+                f"{self.breaker_threshold}")
+        if not (0.0 < self.client_queue_frac <= 1.0):
+            raise ValueError(
+                f"overload.client_queue_frac must be in (0, 1], got "
+                f"{self.client_queue_frac}")
+
+
+@dataclass
 class NodeConfig:
     """Cluster topology: node id -> (host, port).
 
@@ -408,6 +468,7 @@ class GigapaxosTpuConfig:
     ssl: SSLConfig = field(default_factory=SSLConfig)
     cells: CellsConfig = field(default_factory=CellsConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    overload: OverloadConfig = field(default_factory=OverloadConfig)
     nodes: NodeConfig = field(default_factory=NodeConfig)
     # WAL directory; None = in-memory only (tests).
     log_dir: str | None = None
@@ -477,7 +538,8 @@ def load_properties(path: str) -> GigapaxosTpuConfig:
 
 def apply_env_overrides(cfg: GigapaxosTpuConfig) -> None:
     """Apply ``GPTPU_<SECTION>_<FIELD>`` environment overrides and re-validate."""
-    for sub_name in ("paxos", "placement", "fd", "ssl", "cells", "obs"):
+    for sub_name in ("paxos", "placement", "fd", "ssl", "cells", "obs",
+                     "overload"):
         sub = getattr(cfg, sub_name)
         for f_ in dataclasses.fields(sub):
             env = os.environ.get(f"GPTPU_{sub_name.upper()}_{f_.name.upper()}")
@@ -488,7 +550,8 @@ def apply_env_overrides(cfg: GigapaxosTpuConfig) -> None:
 
 def validate(cfg: GigapaxosTpuConfig) -> None:
     """Re-run dataclass validation (setattr bypasses ``__post_init__``)."""
-    for sub_name in ("paxos", "placement", "fd", "ssl", "cells", "obs"):
+    for sub_name in ("paxos", "placement", "fd", "ssl", "cells", "obs",
+                     "overload"):
         sub = getattr(cfg, sub_name)
         post = getattr(sub, "__post_init__", None)
         if post is not None:

@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 from typing import Callable, Dict, List, Optional
 
+from .. import overload as _ov
 from ..paxos.paystore import PayloadStore
 from ..reconfiguration.consistent_hashing import ConsistentHashRing
 from ..reconfiguration.coordinator import AbstractReplicaCoordinator
@@ -100,6 +101,7 @@ class ModeBReplicaCoordinator(AbstractReplicaCoordinator):
         payload: bytes,
         callback: Optional[Callable[[int, Optional[bytes]], None]] = None,
         entry: Optional[str] = None,
+        deadline: Optional[int] = None,
     ) -> Optional[int]:
         if self._epoch.get(name) != epoch:
             return None  # wrong/old epoch: client must re-resolve actives
@@ -115,7 +117,14 @@ class ModeBReplicaCoordinator(AbstractReplicaCoordinator):
             return None
         if isinstance(payload, bytes):
             payload = self._paystore.intern(payload)
-        return self.node.propose(pname, payload, callback)
+        return self.node.propose(pname, payload, callback,
+                                 deadline=deadline, cls=_ov.CLS_CLIENT)
+
+    @property
+    def intake_governor(self):
+        """The node's IntakeGovernor (None when overload control is off) —
+        the AR pre-checks it so scalar sheds NACK at ingress (ISSUE 14)."""
+        return getattr(self.node, "overload", None)
 
     def create_replica_group(
         self, name: str, epoch: int, initial_state: bytes, nodes: List[str],

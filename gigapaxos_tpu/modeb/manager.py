@@ -39,6 +39,7 @@ import numpy as np
 
 from ..config import GigapaxosTpuConfig
 from ..models.replicable import Replicable
+from .. import overload as _overload
 from ..net.messenger import Messenger
 from ..net.transport import SendFailure
 from ..ops.tick import TickInbox
@@ -244,6 +245,14 @@ class ModeBNode(ModeBCommon):
         self._frame_applied_tick: Dict[int, int] = {}
         self._last_frame_rx = 0  # our tick count when a frame last arrived
         self.stats = collections.Counter()
+        # intake governor: watermark shed of client-class proposes when the
+        # staged+outstanding backlog crosses the high watermark (ISSUE 14)
+        self._ov_node = spill_ns or node_id
+        self.overload = (
+            _overload.IntakeGovernor(cfg.overload.intake_hi,
+                                     cfg.overload.intake_lo,
+                                     node=self._ov_node)
+            if cfg.overload.enabled else None)
         self.lock = ContendedLock()
         # ---- device-resident application (models/device_kv.py) ----
         # The per-process deployment twin of Mode A's device_app
@@ -629,10 +638,16 @@ class ModeBNode(ModeBCommon):
     # ---------------------------------------------------------------- propose
     def propose(self, name: str, payload: bytes,
                 callback: Optional[Callable[[int, Optional[bytes]], None]] = None,
-                stop: bool = False) -> Optional[int]:
+                stop: bool = False, deadline: Optional[int] = None,
+                cls: int = _overload.CLS_CONTROL) -> Optional[int]:
         """Lock-free fast path: stage the request for the next tick's drain
         (see paxos/manager.propose — the existence/fenced pre-checks are
-        racy reads; the authoritative outcome rides the callback)."""
+        racy reads; the authoritative outcome rides the callback).
+
+        ``deadline`` is the wire deadline in unix ms (0/None = none);
+        expired work is dropped at drain with RID_EXPIRED.  ``cls`` is the
+        traffic class: client-class proposes are subject to the intake
+        governor's watermark shed (RID_BUSY), control-class never."""
         wal = self.wal
         _aw = getattr(wal, "accepting_writes", None)  # test stubs lack it
         if _aw is not None and not _aw():
@@ -643,6 +658,16 @@ class ModeBNode(ModeBCommon):
             with self.lock:
                 if callback is not None:
                     self._held_callbacks.append((callback, -1, None))
+            return None
+        if (cls == _overload.CLS_CLIENT and self.overload is not None
+                and not self.overload.admit(cls)):
+            # watermark shed: explicit retriable busy NACK, never silent
+            self.stats["shed_requests"] += 1
+            _overload.count_shed(cls, "intake", self._ov_node)
+            with self.lock:
+                if callback is not None:
+                    self._held_callbacks.append(
+                        (callback, _overload.RID_BUSY, None))
             return None
         row = self.rows.row(name)  # racy read: benign for the POSITIVE case
         if row is None or row in self._stopped_rows:
@@ -658,7 +683,7 @@ class ModeBNode(ModeBCommon):
                         self._held_callbacks.append((callback, -1, None))
                     return None
         rid = self.next_rid()
-        self._staged.append((rid, name, payload, callback, stop))
+        self._staged.append((rid, name, payload, callback, stop, deadline))
         if self.reqtrace.enabled:
             self.reqtrace.event(rid, "staged", name=name, node=self.node_id)
         self._wake()
@@ -668,9 +693,22 @@ class ModeBNode(ModeBCommon):
         """Admit staged proposals (start of each tick, lock held)."""
         while True:
             try:
-                rid, name, payload, callback, stop = self._staged.popleft()
+                (rid, name, payload, callback, stop,
+                 deadline) = self._staged.popleft()
             except IndexError:
                 return
+            if _overload.expired(deadline):
+                # deadline passed while staged: nobody is waiting — settle
+                # with RID_EXPIRED (AR drops it silently, never responds)
+                if callback is not None:
+                    self._held_callbacks.append(
+                        (callback, _overload.RID_EXPIRED, None))
+                self.stats["expired_drops"] += 1
+                _overload.count_expired("intake", self._ov_node)
+                if self.reqtrace.enabled:
+                    self.reqtrace.event(rid, "expired", name=name,
+                                        node=self.node_id)
+                continue
             row = self.rows.row(name)
             if row is None and name in self._paused:
                 row = self._unpause(name)
@@ -805,6 +843,16 @@ class ModeBNode(ModeBCommon):
     def tick(self):
         pc = self._pc
         pc.begin()
+        if self.overload is not None:
+            # feed the governor the client-work backlog: staged + queued +
+            # unresponded outstanding (NOT pending_count — that adds driver
+            # keep-ticking slop that would poison small watermarks)
+            with self.lock:
+                backlog = (len(self._staged)
+                           + sum(len(q) for q in self._queues.values())
+                           + sum(1 for rec in self.outstanding.values()
+                                 if not rec.responded))
+            self.overload.update(backlog)
         with self.lock:
             self._refresh_alive()
             self._flush_mirrors()

@@ -12,9 +12,12 @@ Frame kinds ride the transport's raw-bytes channel behind 4-byte magics,
 chained with the other bytes consumers (mode-B frames, bulk transfers).
 
 Request frame  (client -> active):
-  b"GBR1" | bid u64 | host u8+bytes | port u16 | client_id u8+bytes
-  | n_names u16 | {u16 len + bytes} * n_names
+  b"GBR1" | bid u64 | deadline u64 | host u8+bytes | port u16
+  | client_id u8+bytes | n_names u16 | {u16 len + bytes} * n_names
   | n u32 | name_idx u16*n | rid u64*n | plen u32*n | payload blob
+  ``deadline`` is the batch's absolute wire deadline in unix milliseconds
+  (0 = none) — the overload plane's dead-work cutoff (overload.py); one
+  per frame because a client tick's batch shares a send instant.
 Deduped request frame (ordering/dissemination split, Mode A bulk store):
   b"GBR2" | <same header through rid u64*n>
   | n_uniq u32 | ulen u32*n_uniq | pidx u32*n | unique payload blob
@@ -35,6 +38,7 @@ from typing import List, Tuple
 
 import numpy as np
 
+from ..overload import CLS_CLIENT
 from .transport import SendFailure
 
 REQ_MAGIC = b"GBR1"
@@ -67,7 +71,7 @@ class ClientEgress:
                 return
             for client, frames in buf.items():
                 try:
-                    self.m.send_bytes_many(client, frames)
+                    self.m.send_bytes_many(client, frames, cls=CLS_CLIENT)
                 except SendFailure:
                     # transport closing: responses are simply undeliverable
                     pass
@@ -80,13 +84,14 @@ class ClientEgress:
             buf.setdefault(client, []).append(frame)
             return
         try:
-            self.m.send_bytes(client, frame)
+            self.m.send_bytes(client, frame, cls=CLS_CLIENT)
         except SendFailure:
             pass
 
 
 def _request_head(magic: bytes, bid: int, host: str, port: int,
-                  client_id: str, items) -> Tuple[list, dict, int]:
+                  client_id: str, items,
+                  deadline: int = 0) -> Tuple[list, dict, int]:
     """Shared GBR1/GBR2 header through ``rid u64*n``."""
     names: dict = {}
     for name, _rid, _p in items:
@@ -97,7 +102,7 @@ def _request_head(magic: bytes, bid: int, host: str, port: int,
     rids = np.fromiter((it[1] for it in items), np.uint64, n)
     hb = host.encode()
     cb = client_id.encode()
-    head = [magic, struct.pack("<QB", bid, len(hb)), hb,
+    head = [magic, struct.pack("<QQB", bid, deadline or 0, len(hb)), hb,
             struct.pack("<HB", port, len(cb)), cb,
             struct.pack("<H", len(names))]
     for name in names:
@@ -111,10 +116,12 @@ def _request_head(magic: bytes, bid: int, host: str, port: int,
 
 
 def encode_request(bid: int, host: str, port: int, client_id: str,
-                   items: List[Tuple[str, int, bytes]]) -> bytes:
+                   items: List[Tuple[str, int, bytes]],
+                   deadline: int = 0) -> bytes:
     """items: (name, rid, payload).  Emits GBR2 (unique-payload table)
     when the duplicate bytes it removes exceed the extra index overhead
-    (4 bytes/unique body), else plain GBR1 — decode sniffs the magic."""
+    (4 bytes/unique body), else plain GBR1 — decode sniffs the magic.
+    ``deadline``: absolute unix-ms batch deadline (0 = none)."""
     n = len(items)
     uniq: dict = {}  # body -> table index (content-keyed)
     dup_bytes = 0
@@ -125,7 +132,7 @@ def encode_request(bid: int, host: str, port: int, client_id: str,
             uniq[p] = len(uniq)
     if dup_bytes > 4 * len(uniq):
         head, _names, _n = _request_head(
-            REQ2_MAGIC, bid, host, port, client_id, items)
+            REQ2_MAGIC, bid, host, port, client_id, items, deadline)
         ulens = np.fromiter((len(p) for p in uniq), np.uint32, len(uniq))
         pidx = np.fromiter((uniq[it[2]] for it in items), np.uint32, n)
         head.append(struct.pack("<I", len(uniq)))
@@ -133,21 +140,22 @@ def encode_request(bid: int, host: str, port: int, client_id: str,
         head.append(pidx.tobytes())
         return b"".join(head) + b"".join(uniq)
     head, _names, _n = _request_head(
-        REQ_MAGIC, bid, host, port, client_id, items)
+        REQ_MAGIC, bid, host, port, client_id, items, deadline)
     plens = np.fromiter((len(it[2]) for it in items), np.uint32, n)
     head.append(plens.tobytes())
     return b"".join(head) + b"".join(it[2] for it in items)
 
 
 def decode_request(buf: bytes):
-    """Returns (bid, (host, port), client_id, names, name_idx, rids,
-    payloads list of bytes) for either request-frame kind; GBR2 duplicates
-    come back as the SAME bytes object (pre-interned for the admit path)."""
+    """Returns (bid, deadline_ms, (host, port), client_id, names, name_idx,
+    rids, payloads list of bytes) for either request-frame kind; GBR2
+    duplicates come back as the SAME bytes object (pre-interned for the
+    admit path).  ``deadline_ms`` is 0 when the sender set none."""
     magic = buf[:4]
     assert magic in (REQ_MAGIC, REQ2_MAGIC)
     o = 4
-    bid, hlen = struct.unpack_from("<QB", buf, o)
-    o += 9
+    bid, deadline, hlen = struct.unpack_from("<QQB", buf, o)
+    o += 17
     host = buf[o:o + hlen].decode()
     o += hlen
     port, clen = struct.unpack_from("<HB", buf, o)
@@ -181,13 +189,15 @@ def decode_request(buf: bytes):
         utab = [bytes(mv[o + uoffs[i]:o + uoffs[i + 1]])
                 for i in range(n_uniq)]
         payloads = [utab[i] for i in pidx]
-        return bid, (host, port), client_id, names, idx, rids, payloads
+        return (bid, int(deadline), (host, port), client_id, names, idx,
+                rids, payloads)
     plens = np.frombuffer(buf, np.uint32, n, o)
     o += 4 * n
     offs = np.zeros(n + 1, np.int64)
     np.cumsum(plens, out=offs[1:])
     payloads = [bytes(mv[o + offs[i]:o + offs[i + 1]]) for i in range(n)]
-    return bid, (host, port), client_id, names, idx, rids, payloads
+    return (bid, int(deadline), (host, port), client_id, names, idx, rids,
+            payloads)
 
 
 def encode_response(bid: int, rids, statuses, bodies: List[bytes]) -> bytes:

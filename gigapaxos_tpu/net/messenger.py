@@ -15,6 +15,7 @@ import json
 from typing import Dict, Iterable, Optional, Tuple
 
 from ..config import NodeConfig
+from ..overload import CLS_CONTROL
 from .transport import KIND_JSON, JsonDemux, Transport
 
 
@@ -67,26 +68,29 @@ class Messenger:
     def register(self, ptype, handler) -> None:
         self.demux.register(ptype, handler)
 
-    def send(self, dest: str, packet: dict) -> None:
+    def send(self, dest: str, packet: dict, cls: int = CLS_CONTROL) -> None:
         packet.setdefault("sender", self.node_id)
-        self.transport.send(dest, packet)
+        self.transport.send(dest, packet, cls=cls)
 
-    def multicast(self, dests: Iterable[str], packet: dict) -> None:
+    def multicast(self, dests: Iterable[str], packet: dict,
+                  cls: int = CLS_CONTROL) -> None:
         # serialize ONCE and fan the same byte buffer to every destination
         # (GenericMessagingTask sends one marshalled packet to a node set)
         packet.setdefault("sender", self.node_id)
         data = json.dumps(packet).encode()
         for d in dests:
             if d is not None:
-                self.transport.send_raw(d, KIND_JSON, data)
+                self.transport.send_raw(d, KIND_JSON, data, cls=cls)
 
-    def send_bytes(self, dest: str, payload: bytes) -> None:
-        self.transport.send_bytes(dest, payload)
+    def send_bytes(self, dest: str, payload: bytes,
+                   cls: int = CLS_CONTROL) -> None:
+        self.transport.send_bytes(dest, payload, cls=cls)
 
-    def send_bytes_many(self, dest: str, payloads) -> None:
+    def send_bytes_many(self, dest: str, payloads,
+                        cls: int = CLS_CONTROL) -> None:
         """A tick's frame list for one peer: stamped under one transport
         generation so the writer can drain them in a single writev."""
-        self.transport.send_bytes_many(dest, payloads)
+        self.transport.send_bytes_many(dest, payloads, cls=cls)
 
     def close(self) -> None:
         self.transport.close()

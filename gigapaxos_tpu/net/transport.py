@@ -27,8 +27,8 @@ Wire format per frame: ``[u32 len][u8 kind][payload:len-1]``; kind 0 = JSON
 
 from __future__ import annotations
 
+import collections
 import json
-import queue
 import socket
 import ssl as _ssl
 import struct
@@ -37,6 +37,7 @@ import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from ..obs.metrics import registry as _obs_registry
+from ..overload import CLS_CLIENT, CLS_CONTROL, CLS_NAMES
 from ..utils.profiler import profiler
 from .security import TransportSecurity
 
@@ -163,14 +164,22 @@ class FrameReader:
 
 
 class _Peer:
-    """Outbound link to one node: queue + writer thread + reconnect."""
+    """Outbound link to one node: classed queues + writer thread + reconnect.
+
+    Two bounded send queues per link — control (failure detection,
+    reconfiguration, accepts/commits) and client (proposes/reads and their
+    responses) — with separate budgets, and the writer always drains
+    control first.  Overload therefore sheds client-class frames while
+    liveness traffic keeps a full, un-stealable budget (ISSUE 14: a flood
+    of client work must never look like a dead node to the FD plane)."""
 
     def __init__(self, transport: "Transport", dest: str):
         self.t = transport
         self.dest = dest
-        self.q: "queue.Queue[Tuple[int, int, bytes]]" = queue.Queue(
-            maxsize=transport.send_queue_cap
-        )
+        #: per-class bounded deques, indexed by CLS_CONTROL / CLS_CLIENT;
+        #: drain priority is index order (control first)
+        self.dq = (collections.deque(), collections.deque())
+        self.caps = transport.class_caps
         self.sock: Optional[socket.socket] = None
         #: bumped by Transport.reset_peer; frames are stamped with the
         #: generation at enqueue, and the writer drops any frame — including
@@ -180,7 +189,8 @@ class _Peer:
         #: wholly after (stamped fresh, survives)
         self.gen = 0
         self.glock = threading.Lock()
-        self._carry = None  # writer-owned: see _drain_batch
+        #: writer parks here when both queues are empty; producers notify
+        self.cv = threading.Condition(self.glock)
         #: interrupts the writer's reconnect-backoff sleep: set by close()
         #: and Transport.reset_peer so shutdown / peer reset aren't delayed
         #: up to 2 s by a dead link waiting out its backoff
@@ -211,39 +221,39 @@ class _Peer:
                           "connect_failures")
             return None
 
-    def _drain_batch(self, first) -> list:
-        """Coalesce queued frames behind ``first`` into one writev batch,
-        bounded by the coalescing window and — critically — generation
-        homogeneity: the first frame stamped with a different generation
-        ends the batch and is carried into the next one, so a single
-        ``sendmsg`` can never interleave frames across a ``reset_peer``."""
-        batch = [first]
-        nbytes = len(first[2])
-        while (len(batch) < self.t.coalesce_frames
-               and nbytes < self.t.coalesce_bytes):
-            try:
-                nxt = self.q.get_nowait()
-            except queue.Empty:
-                break
-            if nxt[0] != first[0]:
-                self._carry = nxt
-                break
-            batch.append(nxt)
-            nbytes += len(nxt[2])
-        return batch
+    def _take_batch(self) -> Optional[list]:
+        """Pop one writev batch under the queue lock: highest-priority
+        non-empty class (control before client), coalesced up to the
+        window and — critically — generation homogeneity: a frame stamped
+        with a different generation stays queued and starts the next
+        batch, so a single ``sendmsg`` can never interleave frames across
+        a ``reset_peer``.  Returns None only when the transport closes."""
+        with self.cv:
+            while True:
+                for dq in self.dq:
+                    if not dq:
+                        continue
+                    first = dq.popleft()
+                    batch = [first]
+                    nbytes = len(first[2])
+                    while (dq and len(batch) < self.t.coalesce_frames
+                           and nbytes < self.t.coalesce_bytes
+                           and dq[0][0] == first[0]):
+                        nxt = dq.popleft()
+                        batch.append(nxt)
+                        nbytes += len(nxt[2])
+                    return batch
+                if self.t.closed:
+                    return None
+                self.cv.wait(timeout=0.25)
 
     def _run(self) -> None:
         backoff = 0.05
         while not self.t.closed:
-            if self._carry is not None:
-                first, self._carry = self._carry, None
-            else:
-                try:
-                    first = self.q.get(timeout=0.25)
-                except queue.Empty:
-                    continue
-            batch = self._drain_batch(first)
-            gen = first[0]
+            batch = self._take_batch()
+            if batch is None:
+                continue
+            gen = batch[0][0]
             # retry the same batch across reconnects until sent or give up
             attempts = 0
             while not self.t.closed:
@@ -290,6 +300,8 @@ class _Peer:
 
     def close(self) -> None:
         self.wake.set()  # pop the writer out of any reconnect backoff
+        with self.cv:
+            self.cv.notify_all()  # and out of the empty-queue park
         s = self.sock  # snapshot: the writer nulls this field concurrently
         if s is not None:
             try:
@@ -323,11 +335,20 @@ class Transport:
         coalesce_frames: int = _IOV_MAX // 2,
         coalesce_bytes: int = 8 * 1024 * 1024,
         reuse_port: bool = False,
+        client_queue_frac: float = 0.75,
     ):
         self.node_id = node_id
         self.demux = demux
         self.resolve = resolve
         self.send_queue_cap = send_queue_cap
+        #: per-class send budgets (ISSUE 14): control keeps the full cap;
+        #: client-class frames get a smaller, separate budget so a client
+        #: flood sheds client frames and can never crowd out liveness
+        #: traffic (overload must not read as node death to the FD plane)
+        self.class_caps = (
+            send_queue_cap,
+            max(1, int(send_queue_cap * client_queue_frac)),
+        )
         self.connect_timeout_s = connect_timeout_s
         self.max_connect_attempts = max_connect_attempts
         #: bounded coalescing window per writev batch: at most this many
@@ -370,24 +391,30 @@ class Transport:
         self._acceptor.start()
 
     # ------------------------------------------------------------------ sends
-    def send(self, dest: str, obj: Any) -> None:
+    def send(self, dest: str, obj: Any, cls: int = CLS_CONTROL) -> None:
         """Send a JSON-serializable control packet to node ``dest``."""
-        self.send_raw(dest, KIND_JSON, json.dumps(obj).encode())
+        self.send_raw(dest, KIND_JSON, json.dumps(obj).encode(), cls=cls)
 
-    def send_bytes(self, dest: str, payload: bytes) -> None:
-        self.send_raw(dest, KIND_BYTES, payload)
+    def send_bytes(self, dest: str, payload: bytes,
+                   cls: int = CLS_CONTROL) -> None:
+        self.send_raw(dest, KIND_BYTES, payload, cls=cls)
 
-    def send_bytes_many(self, dest: str, payloads) -> None:
-        self.send_raw_many(dest, KIND_BYTES, payloads)
+    def send_bytes_many(self, dest: str, payloads,
+                        cls: int = CLS_CONTROL) -> None:
+        self.send_raw_many(dest, KIND_BYTES, payloads, cls=cls)
 
-    def send_raw(self, dest: str, kind: int, payload: bytes) -> None:
-        self.send_raw_many(dest, kind, (payload,))
+    def send_raw(self, dest: str, kind: int, payload: bytes,
+                 cls: int = CLS_CONTROL) -> None:
+        self.send_raw_many(dest, kind, (payload,), cls=cls)
 
-    def send_raw_many(self, dest: str, kind: int, payloads) -> None:
+    def send_raw_many(self, dest: str, kind: int, payloads,
+                      cls: int = CLS_CONTROL) -> None:
         """Enqueue a tick's worth of frames for ``dest`` under ONE generation
         stamp, so the writer's coalescing drain can put them all in a single
         ``writev`` (frame-at-a-time callers go through here too — a
-        one-element list)."""
+        one-element list).  ``cls`` picks the traffic class: CLS_CONTROL
+        (default — protocol/liveness traffic) or CLS_CLIENT (proposes,
+        reads, and their responses), each with its own bounded budget."""
         if self.closed:
             raise SendFailure("transport closed")
         for payload in payloads:
@@ -416,16 +443,24 @@ class Transport:
             peer = self._peers.get(dest)
             if peer is None:
                 peer = self._peers[dest] = _Peer(self, dest)
-        with peer.glock:
+        with peer.cv:  # cv shares glock: stamp+enqueue atomic vs reset
             gen = peer.gen
+            dq, cap = peer.dq[cls], peer.caps[cls]
+            dropped = 0
             for payload in payloads:
-                try:
-                    peer.q.put_nowait((gen, kind, payload))
-                except queue.Full:
-                    # backpressure: drop-newest, callers with liveness needs
-                    # retry via protocol tasks (congestion handling,
+                if len(dq) >= cap:
+                    # backpressure: drop-newest within THIS class only —
+                    # an explicit, attributable shed (per-peer per-class
+                    # counter), and callers with liveness needs retry via
+                    # protocol tasks (congestion handling,
                     # PaxosManager.java:920-935)
-                    self._count("backpressure_drop")
+                    dropped += 1
+                else:
+                    dq.append((gen, kind, payload))
+            peer.cv.notify()
+        if dropped:
+            self._count("backpressure_drop", dropped)
+            self._count_drop(dest, cls, dropped)
 
     # ---------------------------------------------------------------- receive
     def _accept_loop(self) -> None:
@@ -516,6 +551,24 @@ class Transport:
                     node=self.node_id, peer=peer)
         c.inc(n)
 
+    def _count_drop(self, peer: str, cls: int, n: int = 1) -> None:
+        """Attributable backpressure (ISSUE 14 satellite): every queue-full
+        shed lands in stats["backpressure_drop:<peer>:<class>"] and the
+        ``transport_backpressure_drop_class_total{node,peer,cls}`` family,
+        so "who got shed, toward whom" is a scrape away instead of one
+        opaque global number."""
+        cname = CLS_NAMES.get(cls, str(cls))
+        with self._slock:
+            k = f"backpressure_drop:{peer}:{cname}"
+            self.stats[k] = self.stats.get(k, 0) + n
+            c = self._obs_counters.get(k)
+            if c is None:
+                c = self._obs_counters[k] = _obs_registry().counter(
+                    "transport_backpressure_drop_class_total",
+                    help="send-queue sheds by peer and traffic class",
+                    node=self.node_id, peer=peer, cls=cname)
+        c.inc(n)
+
     def reset_peer(self, dest: str) -> None:
         """Discard everything queued — or held by the writer mid-retry — for
         ``dest`` and drop its connection.  The analog of the reference
@@ -532,12 +585,11 @@ class Transport:
             # bump + drain atomically vs send_raw's stamp+enqueue: nothing
             # fresh can interleave, so everything drained here is stale
             peer.gen += 1  # also strands the writer's in-hand frame
-            while True:
-                try:
-                    peer.q.get_nowait()
-                except queue.Empty:
-                    break
-                self._count("reset_drops")
+            stale = sum(len(dq) for dq in peer.dq)
+            for dq in peer.dq:
+                dq.clear()
+        if stale:
+            self._count("reset_drops", stale)
         # close the socket only (never null peer.sock from this thread — the
         # writer owns that field): a concurrent sendall gets OSError, which
         # the writer's retry path already handles

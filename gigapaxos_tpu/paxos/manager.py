@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import GigapaxosTpuConfig
+from .. import overload as _overload
 from ..models.replicable import Replicable
 from ..types import GroupStatus, NO_REQUEST
 from ..utils.intmap import RowAllocator
@@ -117,6 +118,17 @@ class PaxosManager:
         )
         self._seen_cap = 8 * self.W
         self.stats = collections.Counter()
+        # overload plane (ISSUE 14): watermark-with-hysteresis admission of
+        # CLIENT-class work at the node intake.  Control-class proposes
+        # (epoch stops, RC plane) are never governed — liveness traffic
+        # rides through an overload.  None when disabled.
+        self.overload = (
+            _overload.IntakeGovernor(cfg.overload.intake_hi,
+                                     cfg.overload.intake_lo,
+                                     node=spill_ns or "-")
+            if cfg.overload.enabled else None
+        )
+        self._ov_node = spill_ns or "-"
         self._stopped_rows: set[int] = set()
         # ---- pause/spill (deactivation, PaxosManager.java:2284-2412) ----
         # name -> HotRestoreInfo dict (+ "stopped" flag); device row freed.
@@ -753,8 +765,17 @@ class PaxosManager:
         callback: Optional[Callable[[int, bytes], None]] = None,
         stop: bool = False,
         entry: Optional[int] = None,
+        deadline: Optional[int] = None,
+        cls: int = _overload.CLS_CONTROL,
     ) -> Optional[int]:
         """propose/proposeStop analog (PaxosManager.java:1214-1288).
+
+        ``deadline``: absolute wire deadline (unix ms); a request still
+        staged when it passes is dropped at intake with callback
+        ``(RID_EXPIRED, None)`` — dead work never reaches the device.
+        ``cls``: traffic class; CLS_CLIENT proposes are refused with a
+        retriable busy NACK ``(RID_BUSY, None)`` while the intake
+        governor sheds, CLS_CONTROL (default) is never governed.
 
         Returns the request id, or None if the group is unknown (or fenced
         by a stop).  The common case takes NO manager lock: the request is
@@ -771,6 +792,9 @@ class PaxosManager:
         """
         if self.wal is not None and not self.wal.accepting_writes():
             return self._shed_propose(callback)
+        if (cls == _overload.CLS_CLIENT and self.overload is not None
+                and not self.overload.admit(cls)):
+            return self._shed_busy(callback)
         row = self.rows.row(name)  # racy read: benign (see docstring)
         if row is None:
             if name in self._paused:
@@ -783,7 +807,8 @@ class PaxosManager:
         with self._rid_lock:
             rid = self._next_rid
             self._next_rid += 1
-        self._staged.append((rid, name, payload, callback, stop, entry))
+        self._staged.append((rid, name, payload, callback, stop, entry,
+                             deadline))
         if self.reqtrace.enabled:
             self.reqtrace.event(rid, "staged", name=name)
         return rid
@@ -800,6 +825,18 @@ class PaxosManager:
             self._held_callbacks.append((callback, -1, None))
         self.stats["shed_requests"] += 1
         self.stats["failed_requests"] += 1
+        return None
+
+    @_locked
+    def _shed_busy(self, callback):
+        """Intake governor shed (ISSUE 14): the explicit retriable NACK —
+        the callback fires with RID_BUSY so the edge answers ``busy``
+        (retry the SAME active after backoff) instead of a silent drop or
+        a misleading ``not_active``."""
+        if callback is not None:
+            self._held_callbacks.append((callback, _overload.RID_BUSY, None))
+        self.stats["shed_requests"] += 1
+        _overload.count_shed(_overload.CLS_CLIENT, "intake", self._ov_node)
         return None
 
     @_locked
@@ -853,10 +890,21 @@ class PaxosManager:
         try:
             while True:
                 try:
-                    rid, name, payload, callback, stop, entry = \
+                    rid, name, payload, callback, stop, entry, deadline = \
                         self._staged.popleft()
                 except IndexError:
                     return
+                if _overload.expired(deadline):
+                    # deadline passed while staged: nobody is waiting, so
+                    # admitting it would burn a device slot on dead work.
+                    # RID_EXPIRED tells the edge to settle silently (the
+                    # drop is counted ONCE, here at the detecting stage).
+                    if callback is not None:
+                        self._held_callbacks.append(
+                            (callback, _overload.RID_EXPIRED, None))
+                    self.stats["expired_drops"] += 1
+                    _overload.count_expired("intake", self._ov_node)
+                    continue
                 row = self._resident_row(name)
                 if row is None or row in self._stopped_rows:
                     # the group vanished or stopped between stage and drain
@@ -892,7 +940,8 @@ class PaxosManager:
     @_locked
     def propose_bulk(self, rows, payloads, stops=None,
                      callbacks=None, entries=None,
-                     batch_sink=None) -> np.ndarray:
+                     batch_sink=None,
+                     cls: int = _overload.CLS_CLIENT) -> np.ndarray:
         """Vectorized propose: admit one request per entry of ``rows`` (row
         indices into the group table) in a single columnar operation.
 
@@ -922,6 +971,17 @@ class PaxosManager:
             n = len(rows)
             self.stats["shed_requests"] += n
             self.stats["failed_requests"] += n
+            return np.full(n, -2, np.int64)
+        if (cls == _overload.CLS_CLIENT and self.overload is not None
+                and not self.overload.admit(cls)):
+            # intake governor shed: whole batch refused with the transient
+            # busy code (-2, retry the same active) — bulk is client-class
+            # by default, the only control-class bulk caller is the RC
+            # plane's own manager which passes CLS_CONTROL explicitly
+            n = len(rows)
+            self.stats["shed_requests"] += n
+            _overload.count_shed(_overload.CLS_CLIENT, "intake",
+                                 self._ov_node, n)
             return np.full(n, -2, np.int64)
         store = self._ensure_bulk()
         rows = np.asarray(rows, np.int64)
@@ -1505,6 +1565,13 @@ class PaxosManager:
         the return is the PREVIOUS tick's outbox (None on the first)."""
         pc = self._pc
         pc.begin()
+        if self.overload is not None:
+            # feed the intake governor once per tick: staged + queued +
+            # in-flight scalar work + the live bulk window is the node's
+            # client backlog (watermark-with-hysteresis shed, ISSUE 14)
+            self.overload.update(
+                self.pending_count() + len(self.outstanding)
+                + (self.bulk.n_live if self.bulk is not None else 0))
         self._run_due_laggard_syncs()
         pc.mark("repair")
         if self._device_app:

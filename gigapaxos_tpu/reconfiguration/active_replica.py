@@ -31,6 +31,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from .. import overload as _ov
 from ..net import binbatch
 from ..net.bulk import BulkTransfer
 from ..net.messenger import Messenger
@@ -235,6 +236,11 @@ class ActiveReplica:
     def _on_app_request(self, sender: str, p: dict) -> None:
         pkt.register_client(self.m.nodemap, p)
         name, rid = p["name"], p["rid"]
+        if _ov.expired(p.get("deadline")):
+            # dead on arrival: the client already gave up — never propose,
+            # never respond (count-once: this stage detected it)
+            _ov.count_expired("ar_ingress", self.node_id)
+            return
         # anycast entry (sendRequestAnycast, ReconfigurableAppClientAsync
         # :1357): the client sent to an arbitrary active; if we don't host
         # the name, resolve its actives from the RC plane and forward — the
@@ -256,7 +262,7 @@ class ActiveReplica:
         dup, cached = self._dedup_check_insert(key)
         if dup:
             if cached is not None:
-                self.m.send(sender, cached)
+                self.m.send(sender, cached, cls=_ov.CLS_CLIENT)
             return
         try:
             self._handle_app_request(sender, p, key)
@@ -320,10 +326,28 @@ class ActiveReplica:
                 "error": "not_active", "name": name,
             }, cache=False)
             return
+        # classed admission: the scalar propose path both fires the callback
+        # AND returns None on refusal, so shed HERE (one response, at the
+        # edge) rather than mapping the manager's held RID_BUSY callback
+        gov = getattr(self.coord, "intake_governor", None)
+        if gov is not None and not gov.admit(_ov.CLS_CLIENT):
+            _ov.count_shed(_ov.CLS_CLIENT, "ar_ingress", self.node_id)
+            self._finish_request(sender, key, {
+                "type": pkt.APP_RESPONSE, "rid": rid, "ok": False,
+                "error": "busy", "name": name,
+            }, cache=False)
+            return
         self._register_demand(name, sender, epoch)
         need = p.get("need_response", True)
+        dl = p.get("deadline")
+        dl = dl if isinstance(dl, int) and dl > 0 else None
 
         def cb(req_id: int, resp: Optional[bytes]) -> None:
+            if req_id == _ov.RID_EXPIRED:
+                # deadline passed mid-pipeline (counted by the detecting
+                # stage): settle the marker, never respond
+                self._dedup_clear(key)
+                return
             if not need:
                 # fire-and-forget: still resolve the marker (cache success so
                 # a retransmit doesn't re-commit; clear on failure)
@@ -338,15 +362,22 @@ class ActiveReplica:
                     self._dedup_born.pop(key, None)
                 return
             ok = not (req_id < 0 or resp is None)
+            if ok and _ov.expired(dl):
+                # committed but nobody is waiting: drop the response
+                _ov.count_expired("egress", self.node_id)
+                self._dedup_clear(key)
+                return
             self._lat_h.observe(time.perf_counter() - t0)
             if tid is not None:
                 self._xt.event(tid, "ar_responded", node=self.node_id,
                                req=rid, ok=ok)
             if not ok:
-                # epoch stopped underneath us: client must re-resolve actives
+                # busy = transient admission NACK (retry same active);
+                # anything else = epoch stopped underneath us (re-resolve)
+                err = "busy" if req_id == _ov.RID_BUSY else "stopped"
                 self._finish_request(sender, key, {
                     "type": pkt.APP_RESPONSE, "rid": rid, "ok": False,
-                    "error": "stopped", "name": name,
+                    "error": err, "name": name,
                 }, cache=False)
             else:
                 self._finish_request(sender, key, {
@@ -355,7 +386,8 @@ class ActiveReplica:
                 }, cache=True)
 
         r = self.coord.coordinate_request(
-            name, epoch, pkt.b64d(p["payload"]) or b"", cb, entry=self.node_id
+            name, epoch, pkt.b64d(p["payload"]) or b"", cb,
+            entry=self.node_id, deadline=dl,
         )
         if r is None:
             if need:
@@ -377,21 +409,30 @@ class ActiveReplica:
         pkt.register_client(self.m.nodemap, p)
         reply_to = p.get("reply_to") or sender
         bid = p["bid"]
+        dl = p.get("deadline")
+        if _ov.expired(dl):
+            # whole frame dead on arrival: the client gave up already
+            _ov.count_expired("ar_ingress", self.node_id,
+                              n=len(p.get("reqs") or ()))
+            return
         key = (reply_to, ("b", bid))
         dup, cached = self._dedup_check_insert(key)
         if dup:
             if cached is not None:
-                self.m.send(reply_to, cached)
+                self.m.send(reply_to, cached, cls=_ov.CLS_CLIENT)
             return
         reqs = p["reqs"]
+        dl = dl if isinstance(dl, int) and dl > 0 else None
         if not reqs:
             self._dedup_clear(key)
             self.m.send(reply_to, {"type": pkt.APP_RESPONSE_BATCH,
-                                   "bid": bid, "results": []})
+                                   "bid": bid, "results": []},
+                        cls=_ov.CLS_CLIENT)
             return
         results: list = [None] * len(reqs)
         lock = threading.Lock()
         remaining = [len(reqs)]
+        settled = [False] * len(reqs)
 
         def finish() -> None:
             resp = {"type": pkt.APP_RESPONSE_BATCH, "bid": bid,
@@ -406,14 +447,20 @@ class ActiveReplica:
                     self._req_dedup.pop(key, None)
                 self._dedup_born.pop(key, None)
             try:
-                self.m.send(reply_to, resp)
+                self.m.send(reply_to, resp, cls=_ov.CLS_CLIENT)
             except SendFailure:
                 pass  # client/transport gone: completions delivered on the
                 # tick thread must never kill the driver
 
         def settle(i: int, rid, entry) -> None:
-            results[i] = entry
             with lock:
+                # idempotent per index: a manager that both fires the
+                # failure callback AND returns a rejection (WAL shed,
+                # admission shed) must not double-decrement the remainder
+                if settled[i]:
+                    return
+                settled[i] = True
+                results[i] = entry
                 remaining[0] -= 1
                 done = remaining[0] == 0
             if done:
@@ -430,7 +477,10 @@ class ActiveReplica:
         def make_cb(i: int, rid):
             def cb(req_id: int, resp) -> None:
                 if req_id < 0 or resp is None:
-                    settle(i, rid, [rid, False, "stopped"])
+                    err = ("busy" if req_id == _ov.RID_BUSY else
+                           "expired" if req_id == _ov.RID_EXPIRED else
+                           "stopped")
+                    settle(i, rid, [rid, False, err])
                 else:
                     settle(i, rid, [rid, True, pkt.b64e(resp)])
 
@@ -464,7 +514,7 @@ class ActiveReplica:
                     continue
                 r = self.coord.coordinate_request(
                     name, epoch, pkt.b64d(payload_b64) or b"",
-                    make_cb(i, rid), entry=self.node_id,
+                    make_cb(i, rid), entry=self.node_id, deadline=dl,
                 )
                 if r is None:
                     settle(i, rid, [rid, False, "not_active"])
@@ -477,26 +527,33 @@ class ActiveReplica:
     def _on_binary_batch(self, sender: str, buf: bytes) -> None:
         """Binary twin of :meth:`_on_app_request_batch`: columnar decode,
         one bulk admission, columnar response frame."""
-        (bid, addr, client_id, names, name_idx, rids,
+        (bid, dl, addr, client_id, names, name_idx, rids,
          payloads) = binbatch.decode_request(buf)
+        if _ov.expired(dl):
+            # whole frame dead on arrival (one deadline per frame: a client
+            # tick's batch shares a send instant)
+            _ov.count_expired("ar_ingress", self.node_id, n=len(rids))
+            return
         if self.m.nodemap(client_id) is None:
             self.m.nodemap.add(client_id, addr[0], int(addr[1]))
         key = (client_id, ("bb", bid))
         dup, cached = self._dedup_check_insert(key)
         if dup:
             if cached is not None:
-                self.m.send_bytes(client_id, cached)
+                self.m.send_bytes(client_id, cached, cls=_ov.CLS_CLIENT)
             return
         n = len(rids)
         if n == 0:
             self._dedup_clear(key)
             self.m.send_bytes(client_id,
-                              binbatch.encode_response(bid, [], [], []))
+                              binbatch.encode_response(bid, [], [], []),
+                              cls=_ov.CLS_CLIENT)
             return
         statuses = np.zeros(n, np.uint8)
         bodies: list = [b""] * n
         lock = threading.Lock()
         remaining = [n]
+        settled = np.zeros(n, bool)
 
         def finish() -> None:
             frame = binbatch.encode_response(bid, rids, statuses, bodies)
@@ -514,9 +571,13 @@ class ActiveReplica:
             self._egress.emit(client_id, frame)
 
         def settle(i: int, ok: bool, body: bytes) -> None:
-            statuses[i] = 1 if ok else 0
-            bodies[i] = body
             with lock:
+                # idempotent per index (see _on_app_request_batch.settle)
+                if settled[i]:
+                    return
+                settled[i] = True
+                statuses[i] = 1 if ok else 0
+                bodies[i] = body
                 remaining[0] -= 1
                 done = remaining[0] == 0
             if done:
@@ -532,7 +593,10 @@ class ActiveReplica:
         def make_cb(i: int):
             def cb(req_id: int, resp) -> None:
                 if req_id < 0 or resp is None:
-                    settle(i, False, b"stopped")
+                    err = (b"busy" if req_id == _ov.RID_BUSY else
+                           b"expired" if req_id == _ov.RID_EXPIRED else
+                           b"stopped")
+                    settle(i, False, err)
                 else:
                     settle(i, True, resp)
 
@@ -559,6 +623,7 @@ class ActiveReplica:
                     r = self.coord.coordinate_request(
                         names[name_idx[i]], ep, payloads[i], make_cb(i),
                         entry=self.node_id,
+                        deadline=int(dl) if dl else None,
                     )
                     if r is None:
                         settle(i, False, b"not_active")
@@ -644,7 +709,7 @@ class ActiveReplica:
             self.m.send(reply_to, {
                 "type": pkt.APP_RESPONSE, "rid": req["rid"], "ok": False,
                 "error": "not_active", "name": req["name"],
-            })
+            }, cls=_ov.CLS_CLIENT)
             return
         for a, addr in (p.get("addrs") or {}).items():
             if self.m.nodemap(a) is None:
@@ -656,7 +721,7 @@ class ActiveReplica:
         target = _random.choice(p["actives"])
         req["reply_to"] = reply_to
         req["fwd"] = 1
-        self.m.send(target, req)
+        self.m.send(target, req, cls=_ov.CLS_CLIENT)
 
     def _finish_request(self, sender: str, key, packet: dict,
                         cache: bool) -> None:
@@ -669,7 +734,7 @@ class ActiveReplica:
             else:
                 self._req_dedup.pop(key, None)
             self._dedup_born.pop(key, None)
-        self.m.send(sender, packet)
+        self.m.send(sender, packet, cls=_ov.CLS_CLIENT)
 
     def _register_demand(self, name: str, sender: str, epoch: int) -> None:
         self._register_demand_batch(name, sender, epoch, 1)

@@ -24,6 +24,7 @@ from __future__ import annotations
 import abc
 from typing import Callable, Dict, List, Optional
 
+from .. import overload as _ov
 from ..paxos.manager import PaxosManager
 
 
@@ -168,13 +169,21 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
         payload: bytes,
         callback: Optional[Callable[[int, Optional[bytes]], None]] = None,
         entry: Optional[str] = None,
+        deadline: Optional[int] = None,
     ) -> Optional[int]:
         if self._epoch.get(name) != epoch:
             return None  # wrong/old epoch: client must re-resolve actives
         slot = self._slot.get(entry) if entry is not None else None
         return self.manager.propose(
-            self._pax_name(name, epoch), payload, callback, entry=slot
+            self._pax_name(name, epoch), payload, callback, entry=slot,
+            deadline=deadline, cls=_ov.CLS_CLIENT,
         )
+
+    @property
+    def intake_governor(self):
+        """The manager's overload governor (None when disabled) — the edge
+        (ActiveReplica) consults it to NACK client work before decoding."""
+        return getattr(self.manager, "overload", None)
 
     @property
     def supports_batch_sink(self) -> bool:

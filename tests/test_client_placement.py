@@ -68,9 +68,9 @@ def test_client_routes_by_override_without_rc_roundtrip():
         sent = []
         orig_send = c.m.send
 
-        def spy(dest, p):
+        def spy(dest, p, **kw):
             sent.append(dest)
-            return orig_send(dest, p)
+            return orig_send(dest, p, **kw)
 
         c.m.send = spy
 

@@ -95,6 +95,13 @@ WIRING = {
     "wal_appended_bytes_total": "gigapaxos_tpu/wal/logger.py",
     "wal_checkpoint_seconds": "gigapaxos_tpu/wal/logger.py",
     "transport_writev_batch_frames": "gigapaxos_tpu/net/transport.py",
+    # overload plane (ISSUE 14): per-class backpressure sheds at the
+    # transport edge; deadline drops / admission NACKs in overload.py
+    "transport_backpressure_drop_class_total":
+        "gigapaxos_tpu/net/transport.py",
+    "overload_expired_drops_total": "gigapaxos_tpu/overload.py",
+    "overload_admission_shed_total": "gigapaxos_tpu/overload.py",
+    "overload_intake_shedding": "gigapaxos_tpu/overload.py",
     # ordering/dissemination split (ISSUE 12): coordinator egress economics
     # and ring-hop latency live in the Mode B manager
     "egress_bytes_per_decision": "gigapaxos_tpu/modeb/manager.py",

@@ -1,0 +1,132 @@
+"""Overload plane integration tests (ISSUE 14): the real stack — client
+edge, ActiveReplica ingress, Mode A manager — over real sockets, driven by
+the open-loop harness.  One module-scoped cluster; the slow-marked leg
+re-runs the full bench out of process and checks its gates.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from gigapaxos_tpu import overload
+from gigapaxos_tpu.reconfiguration import packets as pkt
+from gigapaxos_tpu.obs.metrics import registry
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _expired_total(stage: str) -> int:
+    return sum(int(m.value)
+               for m in registry().find("overload_expired_drops_total")
+               if dict(m.labels).get("stage") == stage)
+
+
+@pytest.fixture(scope="module")
+def overload_cluster():
+    from gigapaxos_tpu.testing.openloop import make_overload_cluster
+
+    cluster, client = make_overload_cluster(n_groups=2, intake_hi=64)
+    yield cluster, client
+    client.close()
+    cluster.close()
+
+
+def test_ar_ingress_drops_already_expired_silently(overload_cluster):
+    """A request whose deadline passed in flight is dropped at the AR edge:
+    no propose, no response (the client already gave up), one ar_ingress
+    counter bump — the count-once contract."""
+    _cluster, client = overload_cluster
+    before = _expired_total("ar_ingress")
+    fired = []
+    rid = client._rid()
+    with client._lock:
+        client._callbacks[rid] = fired.append
+        client._cb_deadline[rid] = time.monotonic() + 5.0
+    p = pkt.app_request("g0", b"dead-on-arrival", rid)
+    p["deadline"] = 1  # 1 ms past the epoch: expired decades ago
+    client.m.send("AR0", client._stamp(p), cls=overload.CLS_CLIENT)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if _expired_total("ar_ingress") > before:
+            break
+        time.sleep(0.02)
+    assert _expired_total("ar_ingress") > before
+    time.sleep(0.3)  # a response would have arrived by now if one existed
+    assert not fired  # dropped silently: nobody is waiting for the answer
+    with client._lock:  # clean up the never-to-fire callback registration
+        client._callbacks.pop(rid, None)
+        client._cb_deadline.pop(rid, None)
+
+
+def test_edge_nacks_busy_then_resumes(overload_cluster):
+    """While the intake governor sheds, the AR answers client work with the
+    explicit retriable ``busy`` NACK; once the watermark clears the same
+    request path succeeds — refuse fast, then resume."""
+    cluster, client = overload_cluster
+    gov = cluster.actives["AR0"].coord.intake_governor
+    assert gov is not None
+
+    def ask():
+        got, ev = [], threading.Event()
+        client.send_request("g0", b"probe",
+                            lambda p: (got.append(p), ev.set()),
+                            active="AR0")
+        assert ev.wait(10), "no response from AR0"
+        return got[0]
+
+    hi, lo = gov.hi, gov.lo
+    # hi=0 makes every tick's governor feed re-enter shedding (backlog >= 0)
+    # so the manual state survives the tick loop; lo=0 keeps it latched
+    gov.hi = 0
+    gov.lo = 0
+    try:
+        time.sleep(0.1)  # one governed tick
+        resp = ask()
+        assert not resp.get("ok") and resp.get("error") == "busy", resp
+    finally:
+        gov.hi, gov.lo = hi, lo
+        gov.update(0)  # backlog below lo: admission resumes
+    resp = ask()
+    assert resp.get("ok"), resp
+
+
+def test_open_loop_ramp_sheds_past_the_knee(overload_cluster):
+    """Mini tier-1 ramp: an in-budget rung completes with zero losses; an
+    over-the-knee rung triggers client-class sheds while the control class
+    sheds nothing (the starvation check on live counters)."""
+    from gigapaxos_tpu.testing.openloop import OpenLoopGenerator, shed_totals
+
+    _cluster, client = overload_cluster
+    gen = OpenLoopGenerator(client, ["g0", "g1"], deadline_s=2.0)
+    sheds0 = shed_totals()
+    calm = gen.run_rung(n_clients=300, think_s=1.0, duration_s=0.8)
+    assert calm.admitted > 0
+    assert calm.lost == 0, calm.to_dict()
+    over = gen.run_rung(n_clients=4000, think_s=1.0, duration_s=1.0,
+                        drain_s=4.0)
+    sheds1 = shed_totals()
+    assert over.shed_busy > 0, over.to_dict()  # explicit NACKs, not drops
+    assert over.admitted > 0, over.to_dict()   # admitted work still lands
+    assert sheds1["client"] > sheds0["client"]
+    assert sheds1["control"] == sheds0["control"] == 0
+
+
+@pytest.mark.slow
+def test_overload_bench_smoke_gates():
+    """The committed-artifact pipeline end to end: the bench's own gates
+    (goodput at 2x knee, classed sheds, bounded p99 of admitted, chaos leg
+    S1-clean) must pass in --smoke sizing."""
+    r = subprocess.run(
+        [sys.executable, "benchmarks/overload_bench.py", "--smoke"],
+        cwd=ROOT, capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["gate_pass"], out["gates"]
+    assert out["overload_crash_leg"]["s1_violations"] == 0

@@ -127,8 +127,8 @@ def test_gbr2_roundtrip_and_auto_upgrade():
     assert buf[:4] == binbatch.REQ2_MAGIC
     # the unique table makes the frame ~one body, not 32
     assert len(buf) < 2 * len(shared)
-    bid, (h, p), cid, names, idx, rids, pls = binbatch.decode_request(buf)
-    assert (bid, h, p, cid) == (5, "h0", 9000, "c1")
+    bid, dl, (h, p), cid, names, idx, rids, pls = binbatch.decode_request(buf)
+    assert (bid, dl, h, p, cid) == (5, 0, "h0", 9000, "c1")
     assert pls == [it[2] for it in items]
     # duplicates decode to ONE shared bytes object (pre-interned)
     assert all(pls[i] is pls[0] for i in range(32))

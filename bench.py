@@ -278,6 +278,9 @@ def run_bench() -> dict:
         # so every run now emits the factors next to the headline rate.
         "decisions_per_tick": round(total_decisions / max(n_ticks, 1), 2),
         "ms_per_tick": round(1e3 * dt / max(n_ticks, 1), 3),
+        # self-describing run shape (ISSUE 16): slot-ring depth and the
+        # log/register group split this probe ran with
+        "detail": {"window": W, "mode_mix": {"log": G, "register": 0}},
     }
     if lat_p50 is not None:
         result["commit_latency_ms"] = {

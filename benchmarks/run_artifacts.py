@@ -373,6 +373,28 @@ def bench_overload() -> dict:
     }
 
 
+def bench_register() -> dict:
+    """Register-mode memory artifact (benchmarks/register_bench.py):
+    refreshes results_register_pr16.json — per-group bytes for the W=1
+    register plane vs the W=8 log plane (hard gate: >= 4x reduction), a
+    >= 4M mixed-mode dense allocation driven through a mixed tick, and
+    mixed-kernel decisions/s at 1M groups."""
+    r = _script(["benchmarks/register_bench.py", "--json",
+                 "benchmarks/results_register_pr16.json"], timeout=3600)[-1]
+    if not r["gate_pass"]:
+        raise RuntimeError(
+            f"register memory gate failed: "
+            f"{r['bytes_per_group']['reduction_x']}x < 4x")
+    return {
+        "metric": r["metric"],
+        "value": r["value"],
+        "unit": r["unit"],
+        "dense_mixed_groups": r["dense_mixed_alloc"]["groups_total"],
+        "dec_per_s_1m_mixed": r["dec_per_s_1m_mixed"]["decisions_per_s"],
+        "artifact": r.get("written"),
+    }
+
+
 def bench_cells_capacity() -> dict:
     """Serving-cells capacity sweep (benchmarks/cells_capacity.py):
     refreshes results_capacity_cells_pr8.json (1 -> 2 -> 4 cells with
@@ -458,6 +480,8 @@ def main() -> None:
     run("egress", bench_egress)
     # overload plane (PR 14): knee ramp + classed-shed + deadline gates
     run("overload", bench_overload)
+    # register plane (PR 16): W=1 RMW groups — per-group memory gate
+    run("register", bench_register)
 
     out = args.out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),

@@ -241,6 +241,12 @@ def main() -> None:
             "replicas": R,
             "create_s": round(create_s, 2),
             "wal": bool(args.wal),
+            # every run self-describes its consensus shape: slot-ring depth
+            # and how many groups ran on each plane (ISSUE 16 — numbers
+            # from mixed-mode runs were uninterpretable without these)
+            "window": args.window,
+            "mode_mix": {"log": G,
+                         "register": int(cfg.paxos.register_groups)},
         },
     }
     if lat:
@@ -344,6 +350,8 @@ def mesh_kernel_compare(args) -> None:
             if gspmd_dps else None,
             "decisions": {"gspmd": gspmd_n, "shard_map": smap_n},
             "groups": G,
+            "window": W,
+            "mode_mix": {"log": G, "register": 0},  # mesh path is log-only
             "ticks": args.ticks,
             "mesh": {"devices": n,
                      "replica_shards": args.mesh_replica_shards},

@@ -42,6 +42,18 @@ class PaxosTuning:
     window: int = 4
     # Max replicas per group (padding width of the member table).
     max_replicas: int = 3
+    # Register-mode group capacity (RMWPaxos, arxiv 2001.03362): rows for
+    # groups whose consensus runs IN PLACE on a single-cell register
+    # (W=1 ring) instead of a slot log.  The manager holds them in a
+    # second dense plane alongside the log plane; a new decision
+    # overwrites the register (carry-forward), so per-group HBM is ~W×
+    # smaller and checkpoint size stops growing with decision count.
+    # Laggard repair ships the register (checkpoint transfer), never slot
+    # replay.  0 = no register plane (bit-identical to pre-register
+    # builds).  Composite rows [0, max_groups) are log mode and
+    # [max_groups, max_groups + register_groups) are register mode — the
+    # row index IS the mode bit.
+    register_groups: int = 0
     # Max new proposals accepted per group per tick at each entry replica.
     proposals_per_tick: int = 4
     # Checkpoint every this many executed slots per group
@@ -190,6 +202,10 @@ class PaxosTuning:
         if self.window < 2 or (self.window & (self.window - 1)):
             raise ValueError(
                 f"window must be a power of two >= 2, got {self.window}"
+            )
+        if self.register_groups < 0:
+            raise ValueError(
+                f"register_groups must be >= 0, got {self.register_groups}"
             )
         if self.compact_outbox and self.proposals_per_tick > 31:
             # taken_bits packs the P intake slots into one int32 lane

@@ -1126,3 +1126,123 @@ def make_inbox(n_replicas: int, n_groups: int, per_tick: int) -> TickInbox:
         stop=jnp.zeros((n_replicas, per_tick, n_groups), jnp.bool_),
         alive=jnp.ones((n_replicas,), jnp.bool_),
     )
+
+
+# --------------------------------------------------------------------------
+# Mixed log/register planes (register mode, RMWPaxos arxiv 2001.03362).
+#
+# Register groups run the SAME tick kernel on a second dense state plane
+# built with W=1: the ring degenerates to a single in-place consensus cell
+# (space caps at one outstanding, prepare carryover IS carry-forward, and
+# exec_slot counts versions instead of log length).  The composite row
+# space the manager exposes is [0, G_log) log rows followed by
+# [G_log, G_log + G_reg) register rows — the row index is the mode bit, so
+# one fused program splits the inbox at the static plane boundary, runs
+# paxos_tick_impl per plane, and the host merges the two outboxes back
+# into the composite row space.  No mode mask inside the kernel: the
+# W-generic ring math already IS the register semantics at W=1.
+# --------------------------------------------------------------------------
+
+
+def _split_inbox(inbox: TickInbox, g_log: int):
+    return (
+        TickInbox(inbox.req[:, :, :g_log], inbox.stop[:, :, :g_log],
+                  inbox.alive),
+        TickInbox(inbox.req[:, :, g_log:], inbox.stop[:, :, g_log:],
+                  inbox.alive),
+    )
+
+
+def _paxos_tick_mixed_packed_impl(state, rstate, inbox: TickInbox,
+                                  own_row: int = -1, exec_budget: int = 0):
+    """Fused mixed tick, full (packed) outbox per plane."""
+    g_log = state.exec_slot.shape[1]
+    ib_l, ib_r = _split_inbox(inbox, g_log)
+    state, out_l = paxos_tick_impl(state, ib_l, own_row, exec_budget)
+    rstate, out_r = paxos_tick_impl(rstate, ib_r, own_row, exec_budget)
+    return state, rstate, pack_outbox_impl(out_l), pack_outbox_impl(out_r)
+
+
+paxos_tick_mixed_packed = jax.jit(
+    _paxos_tick_mixed_packed_impl, donate_argnums=(0, 1),
+    static_argnums=(3, 4),
+)
+
+
+def _paxos_tick_mixed_compact_impl(state, rstate, inbox: TickInbox,
+                                   own_row: int, exec_budget: int,
+                                   lag_budget: int):
+    """Fused mixed tick, budgeted compact outbox per plane.  The register
+    plane's compaction flags laggards at lag >= 1 for free: the lag
+    threshold inside _compact_outbox_impl is the plane's own W."""
+    g_log = state.exec_slot.shape[1]
+    ib_l, ib_r = _split_inbox(inbox, g_log)
+    state, out_l = paxos_tick_impl(state, ib_l, own_row, exec_budget)
+    rstate, out_r = paxos_tick_impl(rstate, ib_r, own_row, exec_budget)
+    return (state, rstate,
+            _compact_outbox_impl(out_l, exec_budget, lag_budget),
+            _compact_outbox_impl(out_r, exec_budget, lag_budget))
+
+
+paxos_tick_mixed_compact = jax.jit(
+    _paxos_tick_mixed_compact_impl, donate_argnums=(0, 1),
+    static_argnums=(3, 4, 5),
+)
+
+
+def merge_outbox(out_l: HostOutbox, out_r: HostOutbox) -> HostOutbox:
+    """Concatenate the two planes' full outboxes into the composite row
+    space (register rows offset by G_log positionally — every field is
+    indexed by row, so plain concatenation along the group axis is the
+    whole merge).  The register plane's W=1 exec ring is zero-padded to
+    the log plane's W; safe because consumers read only j < exec_count
+    entries and a register row executes at most one slot per tick."""
+    R, W, _ = out_l.exec_req.shape
+    Rr, Wr, Gr = out_r.exec_req.shape
+
+    def wide(a):
+        if Wr == W:
+            return a
+        pad = np.zeros((Rr, W - Wr, Gr), a.dtype)
+        return np.concatenate([a, pad], axis=1)
+
+    cat = np.concatenate
+    return HostOutbox(
+        exec_req=cat([out_l.exec_req, wide(out_r.exec_req)], axis=2),
+        exec_stop=cat([out_l.exec_stop, wide(out_r.exec_stop)], axis=2),
+        exec_base=cat([out_l.exec_base, out_r.exec_base], axis=1),
+        exec_count=cat([out_l.exec_count, out_r.exec_count], axis=1),
+        intake_taken=cat([out_l.intake_taken, out_r.intake_taken], axis=2),
+        coord_id=cat([out_l.coord_id, out_r.coord_id]),
+        decided_now=cat([out_l.decided_now, out_r.decided_now]),
+        lag=cat([out_l.lag, out_r.lag], axis=1),
+        donor=cat([out_l.donor, out_r.donor], axis=1),
+        donor_exec=cat([out_l.donor_exec, out_r.donor_exec], axis=1),
+        donor_status=cat([out_l.donor_status, out_r.donor_status], axis=1),
+    )
+
+
+def merge_compact_outbox(co_l: CompactHostOutbox, co_r: CompactHostOutbox,
+                         g_log: int) -> CompactHostOutbox:
+    """Merge two planes' compact outboxes into composite rows: counts sum,
+    taken_bits stack along G, and the e_*/l_* columns (already trimmed to
+    valid length by unpack_compact — no padding reaches the host) simply
+    concatenate with the register plane's row ids offset by g_log."""
+    cat = np.concatenate
+    return CompactHostOutbox(
+        n_exec=co_l.n_exec + co_r.n_exec,
+        decided_total=co_l.decided_total + co_r.decided_total,
+        lag_n=co_l.lag_n + co_r.lag_n,
+        taken_bits=np.hstack([co_l.taken_bits, co_r.taken_bits]),
+        e_rid=cat([co_l.e_rid, co_r.e_rid]),
+        e_rep=cat([co_l.e_rep, co_r.e_rep]),
+        e_row=cat([co_l.e_row, co_r.e_row + g_log]),
+        e_slot=cat([co_l.e_slot, co_r.e_slot]),
+        e_stop=cat([co_l.e_stop, co_r.e_stop]),
+        l_rep=cat([co_l.l_rep, co_r.l_rep]),
+        l_row=cat([co_l.l_row, co_r.l_row + g_log]),
+        l_donor=cat([co_l.l_donor, co_r.l_donor]),
+        l_dexec=cat([co_l.l_dexec, co_r.l_dexec]),
+        l_dstat=cat([co_l.l_dstat, co_r.l_dstat]),
+        l_lexec=cat([co_l.l_lexec, co_r.l_lexec]),
+    )

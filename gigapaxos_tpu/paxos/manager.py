@@ -50,9 +50,11 @@ from . import state as st
 from .bulkstore import BulkOverrun, BulkStore
 from .paystore import PayloadStore
 from ..ops.tick import (CompactHostOutbox, HostOutbox, TickInbox,
-                        frontier_rows, paxos_tick_compact,
-                        paxos_tick_compact_demand, paxos_tick_packed,
-                        sweep_frontier, unpack_compact, unpack_outbox)
+                        frontier_rows, merge_compact_outbox, merge_outbox,
+                        paxos_tick_compact, paxos_tick_compact_demand,
+                        paxos_tick_mixed_compact, paxos_tick_mixed_packed,
+                        paxos_tick_packed, sweep_frontier, unpack_compact,
+                        unpack_outbox)
 
 
 @dataclass
@@ -87,8 +89,19 @@ class PaxosManager:
         self.G = cfg.paxos.max_groups
         self.W = cfg.paxos.window
         self.P = cfg.paxos.proposals_per_tick
+        # Register plane (RMWPaxos): a second dense state block at W=1 for
+        # in-place consensus registers.  Composite row space: [0, G) log
+        # rows, [G, G_total) register rows — the row index IS the mode bit,
+        # so every row-keyed host structure below is sized G_total and the
+        # two device planes stay separate jit inputs (mixed tick splits the
+        # composite inbox at the static boundary).  G_reg == 0 keeps every
+        # structure and code path bit-identical to pre-register builds.
+        self.G_reg = cfg.paxos.register_groups
+        self.G_total = self.G + self.G_reg
         self.state = st.init_state(self.R, self.G, self.W)
-        self.rows = RowAllocator(self.G)
+        self.rstate = (st.init_state(self.R, self.G_reg, 1)
+                       if self.G_reg else None)
+        self.rows = RowAllocator(self.G_total, split=self.G)
         self.apps = apps
         self.wal = wal
         self.alive = np.ones(self.R, bool)
@@ -143,30 +156,38 @@ class PaxosManager:
             if cfg.paxos.spill_dir else None,
             cfg.paxos.spill_cache,
         )
-        self._last_active = np.zeros(self.G, np.int64)
+        self._last_active = np.zeros(self.G_total, np.int64)
         self._row_outstanding = collections.Counter()
         # Host mirrors of config state (member mask / group size).  The tick
         # never writes these; they change only in create/remove/pause/unpause
         # — so the hot path (propose placement, execution bookkeeping) reads
         # numpy instead of paying a jitted scalar-index dispatch per request
         # (round-2 profile: ~230us per state.n_members[row] lookup).
-        self._member_np = np.zeros((self.R, self.G), bool)
-        self._n_members_np = np.zeros(self.G, np.int32)
+        self._member_np = np.zeros((self.R, self.G_total), bool)
+        self._n_members_np = np.zeros(self.G_total, np.int32)
         # further host mirrors for the vectorized (bulk/compact) path:
         # stopped flags, row->name, member bitmask, member-ordinal table
-        self._stopped_np = np.zeros(self.G, bool)
-        self._row_name_np = np.empty(self.G, object)
-        self._member_bits = np.zeros(self.G, np.int64)
-        self._member_ord = None  # lazy [R, G] cumulative member ordinal
+        self._stopped_np = np.zeros(self.G_total, bool)
+        self._row_name_np = np.empty(self.G_total, object)
+        self._member_bits = np.zeros(self.G_total, np.int64)
+        self._member_ord = None  # lazy [R, G_total] cumulative member ordinal
+        #: per-row window / laggard threshold: W for log rows, 1 for
+        #: register rows (a register replica one version behind already
+        #: needs the register shipped — there is no ring to catch up from)
+        self._w_np = np.full(self.G_total, self.W, np.int32)
+        self._w_np[self.G:] = 1
         # ---- compacted-outbox / bulk-propose machinery ----
         self._use_compact = bool(cfg.paxos.compact_outbox)
-        self._exec_budget = cfg.paxos.exec_budget or max(4096, 2 * self.G)
+        self._exec_budget = cfg.paxos.exec_budget or max(4096, 2 * self.G_total)
         self._lag_budget = max(64, cfg.paxos.lag_budget)
         from ..ops.tick import CompactLayout
 
         self._compact_layout = CompactLayout(
             self.R, self.G, self._exec_budget, self._lag_budget
         )
+        self._compact_layout_reg = (CompactLayout(
+            self.R, self.G_reg, self._exec_budget, self._lag_budget
+        ) if self.G_reg else None)
         bc = cfg.paxos.bulk_capacity or max(1 << 16, 4 * self.G)
         self._bulk_cap = 1 << (bc - 1).bit_length()
         self.bulk: Optional[BulkStore] = None  # lazy (most managers: unused)
@@ -203,13 +224,18 @@ class PaxosManager:
         #: passed this slot" against THIS, not device state: a payload
         #: swept in the gap makes the very delivery that advanced the
         #: device watermark skip host-side — a silent lost write
-        self._host_exec = np.zeros((self.R, self.G), np.int32)
+        self._host_exec = np.zeros((self.R, self.G_total), np.int32)
         # ---- device-resident application (models/device_kv.py) ----
         self._device_app = bool(cfg.paxos.device_app)
         self.kv = None
         if self._device_app:
             if not self._use_compact:
                 raise ValueError("device_app requires compact_outbox")
+            if self.G_reg:
+                raise ValueError(
+                    "register_groups + device_app is not supported yet: the "
+                    "fused KV program has no mixed-plane formulation"
+                )
             if cfg.paxos.emulate_unreplicated or cfg.paxos.lazy_propagation:
                 raise ValueError(
                     "baseline modes are host-app measurement tools; the "
@@ -252,6 +278,11 @@ class PaxosManager:
             from ..parallel import shard_tick as _stk
             from ..parallel.mesh import make_mesh, state_shardings
 
+            if self.G_reg:
+                raise ValueError(
+                    "register_groups + mesh_devices is not supported yet: "
+                    "the shard_map tick has no mixed-plane formulation"
+                )
             if self._device_app:
                 raise ValueError(
                     "device_app + mesh_devices is not supported yet: the "
@@ -303,22 +334,25 @@ class PaxosManager:
                 from ..parallel import shard_tick as _stk2
 
                 self._demand_dev = _stk2.init_demand(self.mesh, self.G)
-            elif self._use_compact and not self._device_app:
+            elif self._use_compact and not self._device_app \
+                    and not self.G_reg:
                 # single-device compact path: the intake-popcount fold runs
                 # fused inside paxos_tick_compact_demand (no mesh, so the
                 # GSPMD same-jit hazard doesn't apply) instead of the old
-                # O(G*P) host popcount per tick in _process_compact
+                # O(G*P) host popcount per tick in _process_compact.
+                # Mixed planes keep the host fold: placement demand covers
+                # the LOG plane only (register rows never migrate shards).
                 self._demand_dev = jnp.zeros(self.G, jnp.float32)
         # first-occurrence scratch (generation-tagged so no per-tick clear)
-        self._scr_pos = np.zeros(self.R * self.G, np.int64)
-        self._scr_gen = np.zeros(self.R * self.G, np.int64)
+        self._scr_pos = np.zeros(self.R * self.G_total, np.int64)
+        self._scr_gen = np.zeros(self.R * self.G_total, np.int64)
         self._scr2_pos = None  # store-capacity scratch, allocated w/ store
         self._scr2_gen = None
         self._gen = 0
         # preallocated inbox staging buffers; entries placed last tick are
         # zeroed lazily at the next build instead of reallocating R*P*G
-        self._in_req = np.zeros((self.R, self.P, self.G), np.int32)
-        self._in_stp = np.zeros((self.R, self.P, self.G), bool)
+        self._in_req = np.zeros((self.R, self.P, self.G_total), np.int32)
+        self._in_stp = np.zeros((self.R, self.P, self.G_total), bool)
         self._placed: list = []
         #: pipelined mode: (outbox, placed) of the last dispatched tick,
         #: consumed at the start of the next (SURVEY §2.2 item 3)
@@ -349,36 +383,102 @@ class PaxosManager:
         # admin/propose API while a tick driver loops on tick(); one reentrant
         # lock serializes them (the reference synchronizes on the instance map
         # the same way, PaxosManager.java:2284-2412).
+        # register-plane capacity gauge (tests/test_obs_coverage.py WIRING)
+        from ..obs.metrics import registry as _obsreg
+
+        _obsreg().gauge(
+            "register_groups",
+            help="register-mode (RMW) row capacity of this manager",
+        ).set(self.G_reg)
         self.lock = ContendedLock()
         if self.wal is not None:
             self.wal.attach(self)
 
+    # -------------------------------------------------- plane dispatch helpers
+    # The composite row space is [0, G) log + [G, G_total) register; these
+    # helpers are the ONLY places host code maps a composite row onto one
+    # of the two device planes.  All are trivially log-plane passthroughs
+    # when G_reg == 0 (rstate is None).
+
+    def is_register_row(self, row: int) -> bool:
+        return row >= self.G
+
+    def _plane_state(self, row: int):
+        """(plane_state, plane_row) for a composite row."""
+        if row >= self.G:
+            return self.rstate, row - self.G
+        return self.state, row
+
+    def _set_plane_state(self, row: int, new_state) -> None:
+        if row >= self.G:
+            self.rstate = new_state
+        else:
+            self.state = new_state
+
+    def _dev_exec_np(self) -> np.ndarray:
+        """Composite [R, G_total] device exec watermark (one fetch per
+        plane)."""
+        ex = np.asarray(self.state.exec_slot)
+        if self.rstate is None:
+            return ex
+        return np.hstack([ex, np.asarray(self.rstate.exec_slot)])
+
+    def _dev_exec_col(self, row: int) -> np.ndarray:
+        """Device exec watermark column [R] for one composite row."""
+        pst, prow = self._plane_state(row)
+        return np.array(pst.exec_slot[:, prow])
+
+    def _set_exec_status(self, r: int, row: int, exec_slot: int,
+                         status: int) -> None:
+        """Point-write a replica's exec watermark + status on the owning
+        plane (checkpoint-transfer apply)."""
+        pst, prow = self._plane_state(row)
+        self._set_plane_state(row, pst._replace(
+            exec_slot=pst.exec_slot.at[r, prow].set(exec_slot),
+            status=pst.status.at[r, prow].set(status),
+        ))
+
     # ------------------------------------------------------------------ admin
     @_locked
     def create_paxos_instance(
-        self, name: str, members: List[int], epoch: int = 0
+        self, name: str, members: List[int], epoch: int = 0,
+        register: bool = False,
     ) -> bool:
-        """createPaxosInstance analog (PaxosManager.java:611)."""
+        """createPaxosInstance analog (PaxosManager.java:611).
+
+        ``register=True`` births the group on the register plane (in-place
+        RMW consensus; requires cfg.paxos.register_groups > 0) — the mode
+        is permanent for the group's lifetime and journaled with the
+        create."""
         if name in self.rows or name in self._paused:
             return False
-        row = self._alloc_row(name)
+        if register and not self.G_reg:
+            raise ValueError(
+                "register-mode create requires paxos.register_groups > 0")
+        if register:
+            if self.rows.full(hi=True):
+                return False
+            row = self.rows.alloc(name, hi=True)
+        else:
+            row = self._alloc_row(name)
         if row is None:
             return False
         mask = np.zeros((1, self.R), bool)
         for m in members:
             mask[0, m] = True
-        self.state = st.create_groups(
-            self.state,
-            np.array([row], np.int32),
+        pst, prow = self._plane_state(row)
+        self._set_plane_state(row, st.create_groups(
+            pst,
+            np.array([prow], np.int32),
             mask,
             np.array([epoch], np.int32),
-        )
+        ))
         self._set_member_row(row, mask[0], name)
         self._stopped_rows.discard(row)
         self._stopped_np[row] = False
         self._last_active[row] = self.tick_num
         if self.wal is not None:
-            self.wal.log_create(name, members, epoch)
+            self.wal.log_create(name, members, epoch, register=register)
         return True
 
     @_locked
@@ -406,12 +506,15 @@ class PaxosManager:
         mask = np.zeros((1, self.R), bool)
         for m in members:
             mask[0, m] = True
-        self.state = st.create_groups(
-            self.state,
-            np.array([row], np.int32),
+        # the row index encodes the mode: a targeted create at a register
+        # row lands on the register plane with no extra record field
+        pst, prow = self._plane_state(row)
+        self._set_plane_state(row, st.create_groups(
+            pst,
+            np.array([prow], np.int32),
             mask,
             np.array([epoch], np.int32),
-        )
+        ))
         self._set_member_row(row, mask[0], name)
         self._stopped_rows.discard(row)
         self._stopped_np[row] = False
@@ -506,7 +609,9 @@ class PaxosManager:
         # possibly recycled) so stale placements/decisions cannot resolve
         # against a future occupant
         self.drain_pipeline()
-        self.state = st.free_groups(self.state, np.array([row], np.int32))
+        pst, prow = self._plane_state(row)
+        self._set_plane_state(
+            row, st.free_groups(pst, np.array([prow], np.int32)))
         self._kv_clear_rows([row])
         self._clear_member_rows([row])
         self.rows.free(name)
@@ -553,7 +658,7 @@ class PaxosManager:
         row = self.rows.row(name)
         if row is None:
             return None
-        return np.array(self.state.exec_slot[:, row])
+        return self._dev_exec_col(row)
 
     # ---------------------------------------------------------- placement
     def shard_geometry(self) -> tuple:
@@ -646,7 +751,7 @@ class PaxosManager:
         if self.bulk is not None and (
             self.bulk.n_live or self._bulk_leftover.size or self._bulk_chunks
         ):
-            bulk_ref = np.zeros(self.G, bool)
+            bulk_ref = np.zeros(self.G_total, bool)
             bulk_ref[self.bulk.row[self.bulk.valid]] = True
             parts = ([self._bulk_leftover] if self._bulk_leftover.size
                      else []) + self._bulk_chunks
@@ -662,6 +767,11 @@ class PaxosManager:
         for name, row in cands:
             if len(paused) >= limit:
                 break
+            if row >= self.G:
+                # register rows never pause: their whole footprint is the
+                # register cell (no ring to reclaim), and hot_restore/HRI
+                # extraction are log-plane shaped
+                continue
             if self.tick_num - self._last_active[row] < idle_after:
                 if not ignore_idle:
                     break  # sorted: everything later is hotter
@@ -1453,7 +1563,7 @@ class PaxosManager:
                 qk = np.zeros(0, np.int64)
         else:
             qk = np.zeros(0, np.int64)
-        key = (entries.astype(np.int64) * self.G + rows).astype(np.intp)
+        key = (entries.astype(np.int64) * self.G_total + rows).astype(np.intp)
         # up to P requests per (entry, row) per tick: P first-occurrence
         # passes assign p slots in arrival order (device admission is FIFO
         # across p for one entry, so per-key order is preserved)
@@ -1538,7 +1648,7 @@ class PaxosManager:
         # re-enqueues from the pre-repair outbox, and paying a pipeline
         # drain just to have every sync refuse (donor not ahead) would
         # stall the device/host overlap on the tick after every repair
-        exec_slot = np.asarray(self.state.exec_slot)
+        exec_slot = self._dev_exec_np()
         still, seen = [], set()
         for r_, row_ in due:
             key = (int(r_), int(row_))
@@ -1548,7 +1658,10 @@ class PaxosManager:
             ms = self._member_np[:, key[1]]
             if not ms[key[0]]:
                 continue
-            if exec_slot[ms, key[1]].max() - exec_slot[key] >= self.W:
+            # per-row window: a register row (W=1) can never ring-replay,
+            # so ANY lag routes through checkpoint transfer
+            if (exec_slot[ms, key[1]].max() - exec_slot[key]
+                    >= self._w_np[key[1]]):
                 still.append(key)
         if not still:
             return
@@ -1609,7 +1722,19 @@ class PaxosManager:
         elif self._mesh_tick is not None:
             self.state, packed = self._mesh_tick(self.state, inbox)
         elif self._use_compact:
-            if self._demand_dev is not None:
+            if self.rstate is not None:
+                # mixed planes: one fused program splits the composite
+                # inbox at g_log, ticks both planes with their native W
+                # (log ring vs register), and compacts each — merged back
+                # into one composite outbox at completion
+                self.state, self.rstate, flat_l, flat_r = (
+                    paxos_tick_mixed_compact(
+                        self.state, self.rstate, inbox, -1,
+                        self._exec_budget, self._lag_budget,
+                    )
+                )
+                packed = (flat_l, flat_r)
+            elif self._demand_dev is not None:
                 # placement: the intake-demand EWMA folds on device inside
                 # the fused program (the mesh path's separate-dispatch twin
                 # lives in make_shardmap_tick_compact)
@@ -1625,6 +1750,10 @@ class PaxosManager:
                 self.state, packed = paxos_tick_compact(
                     self.state, inbox, -1, self._exec_budget, self._lag_budget
                 )
+        elif self.rstate is not None:
+            self.state, self.rstate, pk_l, pk_r = paxos_tick_mixed_packed(
+                self.state, self.rstate, inbox, -1, 0)
+            packed = (pk_l, pk_r)
         else:
             self.state, packed = paxos_tick_packed(self.state, inbox, -1)
         # Device sweep frontier: computed ONLY at the dispatch whose
@@ -1642,7 +1771,10 @@ class PaxosManager:
         # falls back to the host reductions (correct, only slower).
         frontier = None
         done_at = self.tick_num + (2 if self.cfg.paxos.pipeline_ticks else 1)
-        if done_at % self._sweep_every == 0 and (
+        # mixed planes skip the device frontier: its [G]-indexed gathers
+        # clip composite register rows onto log row G-1.  The host sweep
+        # fallback reads the composite watermark via _dev_exec_np().
+        if self.rstate is None and done_at % self._sweep_every == 0 and (
             self.outstanding or (self.bulk is not None and self.bulk.n_live)
         ):
             fr = sweep_frontier(
@@ -1704,9 +1836,21 @@ class PaxosManager:
         # outside tick(), and cross-call idle time must not land in "tally"
         pc.touch()
         if self._use_compact:
-            flat = np.asarray(packed)
-            out = unpack_compact(flat, self.R, self.G,
-                                 self._exec_budget, self._lag_budget)
+            if isinstance(packed, tuple):
+                # mixed planes: two per-plane compact buffers; unpack each
+                # against its own geometry, then merge with register rows
+                # re-offset into the composite row space
+                co_l = unpack_compact(np.asarray(packed[0]), self.R, self.G,
+                                      self._exec_budget, self._lag_budget)
+                co_r = unpack_compact(np.asarray(packed[1]), self.R,
+                                      self.G_reg, self._exec_budget,
+                                      self._lag_budget)
+                out = merge_compact_outbox(co_l, co_r, self.G)
+                flat = None
+            else:
+                flat = np.asarray(packed)
+                out = unpack_compact(flat, self.R, self.G,
+                                     self._exec_budget, self._lag_budget)
             e_resp = e_miss = None
             if self._device_app:
                 # extras sliced through the shared layout descriptor —
@@ -1724,6 +1868,16 @@ class PaxosManager:
                 from ..parallel.shard_tick import fetch_host_outbox
 
                 out = fetch_host_outbox(packed)
+            elif isinstance(packed, tuple):
+                # mixed planes (full-outbox mode): unpack per plane —
+                # register plane is W=1 / G_reg columns — and merge into a
+                # composite [.., G_total] outbox (register exec lanes are
+                # zero-padded up to W; exec_count there is at most 1)
+                out_l = unpack_outbox(packed[0], self.R, self.P, self.W,
+                                      self.G)
+                out_r = unpack_outbox(packed[1], self.R, self.P, 1,
+                                      self.G_reg)
+                out = merge_outbox(out_l, out_r)
             else:
                 out = unpack_outbox(packed, self.R, self.P, self.W, self.G)
             pc.mark("tally")
@@ -1807,8 +1961,10 @@ class PaxosManager:
         self.stats["decisions"] += int(out.decided_now.sum())
         if self._placement is not None and self._demand_dev is None:
             # host demand fold (full-outbox path): per-group decisions are
-            # visible here, unlike the compact flat buffer
-            self._placement.observe_intake(np.asarray(out.decided_now))
+            # visible here, unlike the compact flat buffer.  Placement
+            # covers the log plane only — slice off register columns.
+            self._placement.observe_intake(
+                np.asarray(out.decided_now)[:self.G])
         # Self-heal laggards in FULL-outbox mode too (the compact path has
         # the twin block in _process_compact): a replica >= W behind can
         # never catch up by ring sync — its missed slots rotated out of
@@ -1820,8 +1976,11 @@ class PaxosManager:
         # _run_due_laggard_syncs).
         if (self.cfg.paxos.auto_laggard_sync
                 and getattr(self, "_replay_process", None) is None):
+            # per-row window: register rows (W=1) flag at any lag — their
+            # single ring plane was already overwritten
             lag = np.asarray(out.lag)
-            self._lag_sync_due.extend(zip(*np.where(lag >= self.W)))
+            self._lag_sync_due.extend(
+                zip(*np.where(lag >= self._w_np[None, :lag.shape[1]])))
 
     def _execute_one(self, r: int, row: int, name: str, rid: int, slot: int,
                      is_stop: bool) -> None:
@@ -2041,11 +2200,13 @@ class PaxosManager:
             # decisions are gone from the flat buffer, so fold the intake
             # acceptance bits instead — popcount of each row's taken mask
             bits = co.taken_bits.astype(np.int64)
-            per_row = np.zeros(self.G, np.int64)
+            per_row = np.zeros(bits.shape[1], np.int64)
             for _ in range(self.P):
                 per_row += (bits & 1).sum(axis=0)
                 bits >>= 1
-            self._placement.observe_intake(per_row)
+            # placement covers the log plane only: composite register
+            # columns (rows >= G) are sliced off before the demand fold
+            self._placement.observe_intake(per_row[:self.G])
         self._lag_pending = (co.l_rep.copy(), co.l_row.copy(),
                              co.l_donor.copy(), co.l_dexec.copy(),
                              co.l_dstat.copy(), co.l_lexec.copy())
@@ -2096,7 +2257,7 @@ class PaxosManager:
         # _host_exec): device exec includes the in-flight pipelined tick's
         # executions, whose host deliveries still need their payloads
         exec_slot = self._host_exec
-        dev_exec = np.array(self.state.exec_slot)
+        dev_exec = self._dev_exec_np()
         if self.bulk is not None and self.bulk.n_live:
             # vectorized twin for the store
             s = self.bulk
@@ -2116,7 +2277,7 @@ class PaxosManager:
             sel = np.nonzero(
                 s.valid & s.responded & (s.slot >= 0) & any_live[s.row]
                 & ((s.slot < amin[s.row])
-                   | (s.slot < base[s.row] - self.W))
+                   | (s.slot < base[s.row] - self._w_np[s.row]))
             )[0]
             if len(sel):
                 s.valid[sel] = False
@@ -2138,7 +2299,7 @@ class PaxosManager:
             marks = [int(exec_slot[m, rec.row]) for m in ms]
             dbase = max(int(dev_exec[m, rec.row]) for m in ms)
             if (all(mk > rec.slot for mk in marks)
-                    or rec.slot < dbase - self.W):  # strict: see above
+                    or rec.slot < dbase - self._w_np[rec.row]):  # strict
                 dead.append(rid)
         for rid in dead:
             self._row_outstanding[self.outstanding[rid].row] -= 1
@@ -2259,7 +2420,7 @@ class PaxosManager:
         row = self.rows.row(name)
         if row is None:
             return False
-        exec_slot = np.array(self.state.exec_slot[:, row])
+        exec_slot = self._dev_exec_col(row)
         if donor is None:
             members = np.where(self._member_np[:, row])[0]
             donors = [m for m in members if self.alive[m] and m != r]
@@ -2268,9 +2429,12 @@ class PaxosManager:
             donor = max(donors, key=lambda m: exec_slot[m])
         if exec_slot[donor] <= exec_slot[r]:
             return False
+        # "ship the register": for a register row the checkpoint IS the
+        # register value — the same transfer covers both planes
         ckpt = self.apps[donor].checkpoint(name)
         donor_exec = int(exec_slot[donor])
-        donor_status = int(self.state.status[donor, row])
+        pst, prow = self._plane_state(row)
+        donor_status = int(np.asarray(pst.status[donor, prow]))
         if self.wal is not None:
             self.wal.log_sync(r, name, int(donor), donor_exec, donor_status,
                               ckpt)
@@ -2323,14 +2487,11 @@ class PaxosManager:
                            donor_exec: int, donor_status: int,
                            ckpt: bytes, old_exec: Optional[int] = None) -> None:
         if old_exec is None:
-            old_exec = int(np.asarray(self.state.exec_slot[r, row]))
+            old_exec = int(self._dev_exec_col(row)[r])
         self.apps[r].restore(name, ckpt)
         self._host_exec[r, row] = max(int(self._host_exec[r, row]),
                                       donor_exec)
-        self.state = self.state._replace(
-            exec_slot=self.state.exec_slot.at[r, row].set(donor_exec),
-            status=self.state.status.at[r, row].set(donor_status),
-        )
+        self._set_exec_status(r, row, donor_exec, donor_status)
         self._seen.pop((r, row), None)
         # a transfer skips slots [old, donor) on r without ever reporting
         # them executed — settle the store's books or those requests stay
@@ -2386,7 +2547,7 @@ class PaxosManager:
             pairs = zip(l_rep, l_row)
         else:
             lag = np.array(out.lag)
-            pairs = zip(*np.where(lag >= self.W))
+            pairs = zip(*np.where(lag >= self._w_np[None, :lag.shape[1]]))
         n = 0
         for r, row in pairs:
             if not self.alive[r]:

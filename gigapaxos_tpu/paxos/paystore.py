@@ -27,6 +27,8 @@ import collections
 import hashlib
 from typing import Optional
 
+from ..obs.metrics import registry as _obs_registry
+
 #: bodies below this aren't worth a digest reference (the reference
 #: record itself costs ~20 journal bytes)
 DEDUP_MIN_BYTES = 32
@@ -51,6 +53,17 @@ class PayloadStore:
         self.cap = cap
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
+        # registry counters alongside the plain ints: register-mode bodies
+        # lean on intern sharing across millions of groups, so hit/miss/
+        # eviction rates are a first-class dashboard signal
+        reg = _obs_registry()
+        self._hits_c = reg.counter(
+            "paystore_hits_total", help="payload intern digest hits")
+        self._misses_c = reg.counter(
+            "paystore_misses_total", help="payload intern digest misses")
+        self._evict_c = reg.counter(
+            "paystore_evictions_total", help="payload intern LRU evictions")
 
     def __len__(self) -> int:
         return len(self._by_digest)
@@ -64,12 +77,16 @@ class PayloadStore:
         got = self._by_digest.get(d)
         if got is not None and got == payload:
             self.hits += 1
+            self._hits_c.inc()
             self._by_digest.move_to_end(d)
             return got
         self.misses += 1
+        self._misses_c.inc()
         self._by_digest[d] = payload
         while len(self._by_digest) > self.cap:
             self._by_digest.popitem(last=False)
+            self.evictions += 1
+            self._evict_c.inc()
         return payload
 
     def get(self, digest: bytes) -> Optional[bytes]:

@@ -27,6 +27,13 @@ PLACEMENT_RECORD = "_PLACEMENT"
 #: key prefix distinguishing cell overrides from shard overrides inside the
 #: same ``rc_epochs`` map ("c:<service>" -> packed (host shard, cell))
 CELL_KEY_PREFIX = "c:"
+#: key prefix carrying a name's consensus MODE bit ("m:<service>" -> 1 for
+#: register mode, RMWPaxos / PR 16).  The bit travels with the placement
+#: record so create/migrate on ANY node lands the group in the right plane:
+#: a migration target consults mode_of() before create_paxos_instance, and
+#: row-targeted creates (OP_CREATE_AT) re-derive it from the row index —
+#: the composite row space makes ``row >= G`` the same bit.
+MODE_KEY_PREFIX = "m:"
 #: packing stride for (host shard, cell) into one int: value =
 #: shard * stride + cell — 256 cells per host is far above any core count
 CELL_STRIDE = 256
@@ -68,6 +75,8 @@ class PlacementTable:
         #: migrated across cells (cells/migrator.py); absent = static
         #: ``cell_of`` hash placement
         self.cell_overrides: Dict[str, tuple] = {}
+        #: names pinned to register (RMW) consensus mode; absent = log mode
+        self.register_modes: set = set()
         #: version counter, bumped on every override change and adopted from
         #: the ``_PLACEMENT`` record's epoch — clients key their route-cache
         #: invalidation off it (client._route)
@@ -94,6 +103,26 @@ class PlacementTable:
         """The (host shard, cell) a migrated name now lives in, or None for
         default hash placement."""
         return self.cell_overrides.get(name)
+
+    def set_mode(self, name: str, register: bool = True) -> None:
+        """Pin ``name``'s consensus mode (register vs log).  The bit must
+        be set BEFORE the group is created and never changes afterwards —
+        modes don't mix within a group, so a migrating group re-creates in
+        the same plane on its destination."""
+        if register:
+            self.register_modes.add(name)
+        else:
+            self.register_modes.discard(name)
+        self.epoch += 1
+
+    def clear_mode(self, name: str) -> None:
+        if name in self.register_modes:
+            self.register_modes.discard(name)
+            self.epoch += 1
+
+    def mode_of(self, name: str) -> bool:
+        """True when ``name`` runs in register (RMW) mode."""
+        return name in self.register_modes
 
     def default_shard(self, name: str) -> int:
         primary = self.ring.primary(name)
@@ -158,21 +187,34 @@ class PlacementTable:
         return {"op": "placement_set_cell", "name": PLACEMENT_RECORD,
                 "service": name, "shard": ov[0], "cell": ov[1]}
 
+    def to_mode_command(self, name: str) -> dict:
+        """The committed command installing ``name``'s current mode bit
+        (``placement_clear_mode`` for default log mode)."""
+        if name in self.register_modes:
+            return {"op": "placement_set_mode", "name": PLACEMENT_RECORD,
+                    "service": name}
+        return {"op": "placement_clear_mode", "name": PLACEMENT_RECORD,
+                "service": name}
+
     def load_record(self, record_dict: Optional[dict]) -> None:
         """Adopt the override maps from a ``_PLACEMENT`` record dict (as
         produced by ``ReconfigurationRecord.to_dict`` after rc_db applied
         placement commands); None/missing clears.  Cell overrides live in
-        the same rc_epochs map under ``c:``-prefixed keys; the record's
-        epoch becomes the table's version counter so client route caches
-        invalidate on adoption."""
+        the same rc_epochs map under ``c:``-prefixed keys and mode bits
+        under ``m:``-prefixed keys; the record's epoch becomes the table's
+        version counter so client route caches invalidate on adoption."""
         self.overrides = {}
         self.cell_overrides = {}
+        self.register_modes = set()
         rec = record_dict or {}
         for n, s in rec.get("rc_epochs", {}).items():
             n = str(n)
             if n.startswith(CELL_KEY_PREFIX):
                 self.cell_overrides[n[len(CELL_KEY_PREFIX):]] = \
                     unpack_host_cell(int(s))
+            elif n.startswith(MODE_KEY_PREFIX):
+                if int(s):
+                    self.register_modes.add(n[len(MODE_KEY_PREFIX):])
             else:
                 self.overrides[n] = int(s)
         self.epoch = int(rec.get("epoch", self.epoch + 1))
@@ -215,6 +257,10 @@ def apply_placement_command(records: dict, cmd: dict, make_record) -> dict:
         )
     elif op == "placement_clear_cell":
         rec.rc_epochs.pop(CELL_KEY_PREFIX + service, None)
+    elif op == "placement_set_mode":
+        rec.rc_epochs[MODE_KEY_PREFIX + service] = 1
+    elif op == "placement_clear_mode":
+        rec.rc_epochs.pop(MODE_KEY_PREFIX + service, None)
     else:
         return {"ok": False, "error": "bad_op"}
     rec.epoch += 1  # version counter, mirrors the NC records

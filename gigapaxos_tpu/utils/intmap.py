@@ -13,13 +13,25 @@ from typing import Dict, Iterator, Optional
 
 
 class RowAllocator:
-    """Allocates dense row indices for string names, with recycling."""
+    """Allocates dense row indices for string names, with recycling.
 
-    def __init__(self, capacity: int):
+    Optionally PARTITIONED at ``split``: rows ``[0, split)`` are the
+    default (log-mode) pool, rows ``[split, capacity)`` the high
+    (register-mode) pool — two independent LIFO free-lists so an alloc in
+    either pool stays O(1).  ``split == capacity`` (the default) degrades
+    to the historical single-pool allocator, with identical pop order and
+    snapshot format.
+    """
+
+    def __init__(self, capacity: int, split: Optional[int] = None):
         self.capacity = capacity
+        self.split = capacity if split is None else split
+        if not (0 <= self.split <= capacity):
+            raise ValueError(f"split {split} outside [0, {capacity}]")
         self._name_to_row: Dict[str, int] = {}
         self._row_to_name: Dict[int, str] = {}
-        self._free: list[int] = list(range(capacity - 1, -1, -1))
+        self._free: list[int] = list(range(self.split - 1, -1, -1))
+        self._free_hi: list[int] = list(range(capacity - 1, self.split - 1, -1))
 
     def __len__(self) -> int:
         return len(self._name_to_row)
@@ -27,20 +39,24 @@ class RowAllocator:
     def __contains__(self, name: str) -> bool:
         return name in self._name_to_row
 
-    def full(self) -> bool:
-        return not self._free
+    def full(self, hi: bool = False) -> bool:
+        return not (self._free_hi if hi else self._free)
 
-    def free_count(self) -> int:
-        return len(self._free)
+    def free_count(self, hi: bool = False) -> int:
+        return len(self._free_hi if hi else self._free)
 
-    def alloc(self, name: str) -> int:
+    def alloc(self, name: str, hi: bool = False) -> int:
         if name in self._name_to_row:
             raise KeyError(f"{name!r} already allocated")
-        if not self._free:
+        pool = self._free_hi if hi else self._free
+        if not pool:
             raise MemoryError(
-                f"group table full ({self.capacity}); raise paxos.max_groups"
+                "register row table full "
+                f"({self.capacity - self.split}); raise paxos.register_groups"
+                if hi else
+                f"group table full ({self.split}); raise paxos.max_groups"
             )
-        row = self._free.pop()
+        row = pool.pop()
         self._name_to_row[name] = row
         self._row_to_name[row] = name
         return row
@@ -55,8 +71,9 @@ class RowAllocator:
         """
         if name in self._name_to_row:
             raise KeyError(f"{name!r} already allocated")
+        pool = self._free if row < self.split else self._free_hi
         try:
-            self._free.remove(row)
+            pool.remove(row)
         except ValueError:
             raise KeyError(f"row {row} is not free") from None
         self._name_to_row[name] = row
@@ -67,9 +84,10 @@ class RowAllocator:
         """Most-recently-freed free row in ``[lo, hi)`` (LIFO top first), or
         None.  Deterministic given the free-list content, so a journaled
         replay that re-runs the same search picks the same row."""
-        for r in reversed(self._free):
-            if lo <= r < hi:
-                return r
+        for pool in (self._free, self._free_hi):
+            for r in reversed(pool):
+                if lo <= r < hi:
+                    return r
         return None
 
     def row(self, name: str) -> Optional[int]:
@@ -81,7 +99,7 @@ class RowAllocator:
     def free(self, name: str) -> int:
         row = self._name_to_row.pop(name)
         del self._row_to_name[row]
-        self._free.append(row)
+        (self._free if row < self.split else self._free_hi).append(row)
         return row
 
     def names(self) -> Iterator[str]:
@@ -89,6 +107,13 @@ class RowAllocator:
 
     def items(self):
         return self._name_to_row.items()
+
+    def snapshot_free_rows(self) -> list:
+        """Both free-lists, low pool first, each in verbatim LIFO order —
+        the snapshot format.  ``restore`` re-splits by row index, so the
+        concatenation round-trips exactly (and single-pool snapshots from
+        before partitioning restore unchanged)."""
+        return list(self._free) + list(self._free_hi)
 
     def restore(self, rows: Dict[str, int], free_rows=None) -> None:
         """Reset to a snapshot: name->row map plus the VERBATIM free-list.
@@ -102,9 +127,14 @@ class RowAllocator:
         self._name_to_row = dict(rows)
         self._row_to_name = {row: name for name, row in rows.items()}
         if free_rows is not None:
-            self._free = list(free_rows)
+            self._free = [r for r in free_rows if r < self.split]
+            self._free_hi = [r for r in free_rows if r >= self.split]
         else:
             used = set(rows.values())
             self._free = [
-                r for r in range(self.capacity - 1, -1, -1) if r not in used
+                r for r in range(self.split - 1, -1, -1) if r not in used
+            ]
+            self._free_hi = [
+                r for r in range(self.capacity - 1, self.split - 1, -1)
+                if r not in used
             ]

@@ -180,6 +180,12 @@ OP_SYNC = 6  # checkpoint transfer (laggard repair) — state change outside
 OP_CREATE_AT = 7  # targeted create (placement migration): carries the row
                   # AND the app seed blob — the migrated epoch's state
                   # exists nowhere else once the source epoch is dropped
+OP_REG = 8  # register-plane writes (RMWPaxos mode): placements onto
+            # register rows split out of OP_TICK into a compact record of
+            # (row, rid, entry, p, body-or-digest, stop) tuples — bodies
+            # intern through the same payref dedup, so a register group's
+            # journal cost per decision is ~the 8-byte digest, flat in
+            # decision count (the log plane's ring records keep growing)
 
 
 #: test-only hook: the storage fault-injection plane wraps every journal
@@ -370,8 +376,15 @@ class PaxosLogger:
         return int(os.path.basename(snaps[-1]).split(".")[1])
 
     # ----------------------------------------------------------------- logging
-    def log_create(self, name: str, members: List[int], epoch: int) -> None:
-        self._append(records.dumps((OP_CREATE, name, members, epoch)))
+    def log_create(self, name: str, members: List[int], epoch: int,
+                   register: bool = False) -> None:
+        # the register-mode bit rides as an OPTIONAL 5th field: log-mode
+        # creates keep the historical 4-tuple, so journals from runs that
+        # never touch register mode stay byte-identical to pre-register
+        # builds (and old journals replay unchanged)
+        rec = ((OP_CREATE, name, members, epoch, True) if register
+               else (OP_CREATE, name, members, epoch))
+        self._append(records.dumps(rec))
         self._sync()
 
     def log_creates(self, names, members: List[int], epoch: int) -> None:
@@ -446,17 +459,46 @@ class PaxosLogger:
         """Called by the manager after `_build_inbox`, before running the
         tick: record exactly what was placed, with payloads for replay."""
         m = self.manager
-        placed_with_payloads = []
-        for row, take in m._placed:
-            entries = []
+        g_log = getattr(m, "G", None)
+        has_reg = bool(getattr(m, "G_reg", 0))
+
+        def _entries(take):
+            out = []
             for rid, entry, p in take:
                 rec = m.outstanding.get(rid)
                 if rec is None:
                     continue
-                entries.append((rid, entry, p,
-                                self._ref_payload(rec.payload), rec.stop))
+                out.append((rid, entry, p,
+                            self._ref_payload(rec.payload), rec.stop))
+            return out
+
+        # register-plane placements intern FIRST: the OP_REG record is
+        # appended (and at replay, payref-resolved) before OP_TICK, so
+        # first-appearance order must match record order or a body raw in
+        # OP_TICK could be referenced by the earlier-replayed OP_REG
+        reg_placed = []
+        if has_reg:
+            for row, take in m._placed:
+                if row >= g_log:
+                    entries = _entries(take)
+                    if entries:
+                        # register-plane write, journaled compactly via
+                        # OP_REG — the body rides as an 8-byte payref
+                        # after its first appearance in the epoch (see
+                        # _ref_payload), so per-decision journal cost
+                        # stays ~flat
+                        reg_placed.append((row, entries))
+        placed_with_payloads = []
+        for row, take in m._placed:
+            if has_reg and row >= g_log:
+                continue
+            entries = _entries(take)
             if entries:
                 placed_with_payloads.append((row, entries))
+        if reg_placed:
+            # appended BEFORE the tick record it belongs to; replay
+            # stashes it and folds the rows into the same tick's inbox
+            self._append(records.dumps((OP_REG, tick_num, reg_placed)))
         bulk = None
         bp = getattr(m, "_bulk_placed", None)
         if bp is not None:
@@ -520,8 +562,10 @@ class PaxosLogger:
             # verbatim LIFO free-list: replayed OP_CREATE/OP_UNPAUSE must
             # allocate the SAME rows the live run did (journaled OP_TICK
             # records address groups by row); reconstructing the free list
-            # from rows alone loses the pop order after pause/remove churn
-            "free_rows": list(m.rows._free),
+            # from rows alone loses the pop order after pause/remove churn.
+            # Both pools (log + register) concatenate; restore() re-splits
+            # by row index, so the format round-trips across partitioning.
+            "free_rows": m.rows.snapshot_free_rows(),
             "stopped_rows": set(m._stopped_rows),
             "seen": {k: list(v.items()) for k, v in m._seen.items()},
             "outstanding": [
@@ -585,6 +629,13 @@ class PaxosLogger:
         new_seq = m.tick_num
         path = self._snapshot_path(new_seq)
         state_np = {f: np.asarray(getattr(m.state, f)) for f in m.state._fields}
+        if getattr(m, "rstate", None) is not None:
+            # mixed planes: the register plane snapshots alongside under a
+            # reg_ prefix.  Its arrays are O(G_reg), CONSTANT in decision
+            # count — a register group's checkpoint cost never grows, where
+            # a log group's ring carries W slots of history
+            for f in m.rstate._fields:
+                state_np["reg_" + f] = np.asarray(getattr(m.rstate, f))
         if getattr(m, "kv", None) is not None:
             # device-app state snapshots alongside the consensus arrays
             for f in m.kv._fields:
@@ -648,13 +699,14 @@ class PaxosLogger:
 #: a corrupt-but-CRC-valid record must fail closed before any dispatcher
 #: indexes into it (wal/records.py docstring warning, made real)
 OP_SCHEMA = {
-    OP_CREATE: (4, 4),
+    OP_CREATE: (4, 5),     # optional 5th field: register-mode bit (PR 16)
     OP_REMOVE: (2, 2),
     OP_TICK: (4, 6),       # legacy records lack bulk/kv_reg fields
     OP_PAUSE: (2, 2),
     OP_UNPAUSE: (2, 2),
     OP_SYNC: (4, 7),       # legacy donor-only records have arity 4
     OP_CREATE_AT: (6, 6),
+    OP_REG: (3, 3),        # register-plane writes for the next OP_TICK
 }
 
 
@@ -735,36 +787,42 @@ def _tolerate_or_raise(path: str, idx: int, scan, newest: bool, exc) -> bool:
         "refusing to silently skip it.") from exc
 
 
-def _resolve_tick_payrefs(rec, pay_tab: dict):
-    """Undo journal payload dedup on a decoded OP_TICK record: harvest raw
-    bodies into ``pay_tab`` and swap ``(_PAYREF, digest)`` markers for the
-    bodies they reference.  Runs on EVERY OP_TICK — including ticks the
-    replay loop will skip as inside the snapshot — because a later record
-    may reference a body first journaled in a skipped tick.  Ordering
-    matches the writer (placed entries, then the bulk list).  An
-    unresolvable reference raises ValueError so the caller's corrupt-record
-    policy (_tolerate_or_raise) applies."""
+def _resolve_payload(pl, pay_tab: dict):
+    """Undo journal payload dedup on one payload slot: harvest raw bodies
+    into ``pay_tab`` and swap ``(_PAYREF, digest)`` markers for the bodies
+    they reference.  An unresolvable reference raises ValueError so the
+    caller's corrupt-record policy (_tolerate_or_raise) applies."""
+    if _is_payref(pl):
+        body = pay_tab.get(pl[1])
+        if body is None:
+            raise ValueError(
+                f"dangling payload reference {pl[1].hex()}")
+        return body
+    if isinstance(pl, bytes) and len(pl) >= DEDUP_MIN_BYTES:
+        pay_tab[payload_digest(pl)] = pl
+    return pl
 
-    def _resolve(pl):
-        if _is_payref(pl):
-            body = pay_tab.get(pl[1])
-            if body is None:
-                raise ValueError(
-                    f"dangling payload reference {pl[1].hex()}")
-            return body
-        if isinstance(pl, bytes) and len(pl) >= DEDUP_MIN_BYTES:
-            pay_tab[payload_digest(pl)] = pl
-        return pl
 
-    lst = list(rec)
-    lst[2] = [
-        (row, [(rid, entry, p, _resolve(payload), stop)
+def _resolve_placed(placed, pay_tab: dict):
+    return [
+        (row, [(rid, entry, p, _resolve_payload(payload, pay_tab), stop)
                for rid, entry, p, payload, stop in entries])
-        for row, entries in rec[2]
+        for row, entries in placed
     ]
+
+
+def _resolve_tick_payrefs(rec, pay_tab: dict):
+    """Undo journal payload dedup on a decoded OP_TICK record.  Runs on
+    EVERY OP_TICK — including ticks the replay loop will skip as inside
+    the snapshot — because a later record may reference a body first
+    journaled in a skipped tick.  Ordering matches the writer (placed
+    entries, then the bulk list)."""
+    lst = list(rec)
+    lst[2] = _resolve_placed(rec[2], pay_tab)
     if len(lst) > 4 and lst[4] is not None:
         bulk = lst[4]
-        lst[4] = tuple(bulk[:5]) + ([_resolve(pl) for pl in bulk[5]],)
+        lst[4] = tuple(bulk[:5]) + (
+            [_resolve_payload(pl, pay_tab) for pl in bulk[5]],)
     return tuple(lst)
 
 
@@ -786,6 +844,9 @@ def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
     # (writer resets _pay_seen at every roll), so an empty table fills in
     # from raw bodies as records — including snapshot-skipped ticks — decode
     pay_tab: dict = {}
+    # OP_REG stash: register-plane placements for the NEXT OP_TICK (the
+    # writer appends them immediately before it, same tick_num)
+    pending_reg = None
     paths = sorted(glob.glob(os.path.join(log_dir, "journal.*.log")))
     for path in paths:
         seq = int(os.path.basename(path).split(".")[1])
@@ -798,14 +859,24 @@ def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
                 rec = _load_op(raw, OP_SCHEMA)
                 if rec[0] == OP_TICK:
                     rec = _resolve_tick_payrefs(rec, pay_tab)
+                elif rec[0] == OP_REG:
+                    # resolved even when its tick is snapshot-skipped:
+                    # later records may payref bodies first seen here
+                    rec = (OP_REG, rec[1],
+                           _resolve_placed(rec[2], pay_tab))
             except (ValueError, IndexError) as e:
                 if _tolerate_or_raise(path, idx, scan, newest, e):
                     break
             op = rec[0]
             if op == OP_CREATE:
-                _, name, members, epoch = rec
+                _, name, members, epoch = rec[:4]
+                register = bool(rec[4]) if len(rec) > 4 else False
                 if name not in m.rows:
-                    m.create_paxos_instance(name, members, epoch)
+                    if register:
+                        m.create_paxos_instance(name, members, epoch,
+                                                register=True)
+                    else:
+                        m.create_paxos_instance(name, members, epoch)
             elif op == OP_CREATE_AT:
                 _, name, members, epoch, row, app_seed = rec
                 if name not in m.rows:
@@ -827,9 +898,17 @@ def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
                 else:  # legacy donor-only record (pre-round-5 journals)
                     _, r, name, donor = rec
                     m.sync_laggard(r, name, donor=donor)
+            elif op == OP_REG:
+                pending_reg = (rec[1], rec[2])
             elif op == OP_TICK:
                 _, tick_num, placed, alive_b = rec[:4]
                 bulk_rec = rec[4] if len(rec) > 4 else None
+                if pending_reg is not None:
+                    # fold the stashed register-plane placements into this
+                    # tick's inbox (writer guarantees matching tick_num)
+                    if pending_reg[0] == tick_num:
+                        placed = list(placed) + pending_reg[1]
+                    pending_reg = None
                 if tick_num < m.tick_num:
                     continue  # already inside the snapshot
                 bufs = new_buffers(m)
@@ -909,13 +988,28 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
         snap_seq, (meta, npz_blob) = snap
         arrs = np.load(io.BytesIO(npz_blob))
         m.state = PaxosState(**{f: jnp.asarray(arrs[f]) for f in PaxosState._fields})
+        if m.rstate is not None and any(
+                k.startswith("reg_") for k in arrs.files):
+            # mixed planes: restore the register plane from its reg_-
+            # prefixed snapshot fields
+            m.rstate = PaxosState(**{
+                f: jnp.asarray(arrs["reg_" + f])
+                for f in PaxosState._fields
+            })
         # checkpoints are taken pipeline-drained (host == device), so the
         # snapshot's device watermark IS the host-applied one; leaving
         # _host_exec at zero would disable the sweep's passed-branch until
         # every member executes again post-recovery
-        m._host_exec = np.asarray(m.state.exec_slot).astype(np.int32).copy()
-        m._member_np = np.asarray(m.state.member).copy()
-        m._n_members_np = np.asarray(m.state.n_members).copy()
+        if m.rstate is not None:
+            m._host_exec = m._dev_exec_np().astype(np.int32)
+            m._member_np = np.hstack([np.asarray(m.state.member),
+                                      np.asarray(m.rstate.member)])
+            m._n_members_np = np.hstack([np.asarray(m.state.n_members),
+                                         np.asarray(m.rstate.n_members)])
+        else:
+            m._host_exec = np.asarray(m.state.exec_slot).astype(np.int32).copy()
+            m._member_np = np.asarray(m.state.member).copy()
+            m._n_members_np = np.asarray(m.state.n_members).copy()
         m.tick_num = meta["tick_num"]
         m._next_rid = meta["next_rid"]
         m.rows.restore(meta["rows"], meta.get("free_rows"))
@@ -980,8 +1074,9 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
                              stop, None, entry)
 
     def new_buffers(m):
-        return (np.zeros((m.R, m.P, m.G), np.int32),
-                np.zeros((m.R, m.P, m.G), bool))
+        # composite row space: register columns ride the same inbox
+        return (np.zeros((m.R, m.P, m.G_total), np.int32),
+                np.zeros((m.R, m.P, m.G_total), bool))
 
     def place(bufs, entry, p, row, rid, stop):
         bufs[0][entry, p, row] = rid
@@ -1030,6 +1125,15 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
             # exec budget (if the live run used the compact path) applies
             # here too even though replay consumes the full outbox
             budget = m._exec_budget if m._use_compact else 0
+            if m.rstate is not None:
+                from ..ops.tick import (merge_outbox,
+                                        paxos_tick_mixed_packed)
+
+                state, m.rstate, pk_l, pk_r = paxos_tick_mixed_packed(
+                    state, m.rstate, inbox, -1, budget)
+                out_l = unpack_outbox(pk_l, m.R, m.P, m.W, m.G)
+                out_r = unpack_outbox(pk_r, m.R, m.P, 1, m.G_reg)
+                return state, merge_outbox(out_l, out_r)
             state, packed = paxos_tick_packed(state, inbox, -1, budget)
             return state, unpack_outbox(packed, m.R, m.P, m.W, m.G)
 

@@ -106,6 +106,12 @@ WIRING = {
     # and ring-hop latency live in the Mode B manager
     "egress_bytes_per_decision": "gigapaxos_tpu/modeb/manager.py",
     "ring_hop_seconds": "gigapaxos_tpu/modeb/manager.py",
+    # register mode (ISSUE 16): paystore sharing rates are first-class at
+    # millions of register groups; the gauge sizes the register plane
+    "paystore_hits_total": "gigapaxos_tpu/paxos/paystore.py",
+    "paystore_misses_total": "gigapaxos_tpu/paxos/paystore.py",
+    "paystore_evictions_total": "gigapaxos_tpu/paxos/paystore.py",
+    "register_groups": "gigapaxos_tpu/paxos/manager.py",
     "client_commit_latency_seconds": "gigapaxos_tpu/client.py",
     "client_batch_rtt_seconds": "gigapaxos_tpu/client.py",
     "commit_latency_seconds":

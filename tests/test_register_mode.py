@@ -1,0 +1,266 @@
+"""Register-mode (RMWPaxos, ISSUE 16) functional tests.
+
+A register group collapses the ``[G, W]`` slot ring to a W=1 in-place
+consensus register: accepted value + ballot live in a dense register
+plane (``manager.rstate``), a new decision overwrites rather than
+appends, and the composite row space makes ``row >= G`` the mode bit.
+These tests cover the mode end to end — mixed-plane ticks across all
+dispatch modes, row allocation, laggard repair ("ship the register"),
+WAL checkpoint/replay over mixed planes, and the bit-identity guarantee
+that a build with ``register_groups`` configured but unused behaves
+byte-for-byte like one without.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from gigapaxos_tpu.config import GigapaxosTpuConfig
+from gigapaxos_tpu.models.replicable import KVApp, NoopApp
+from gigapaxos_tpu.paxos.manager import PaxosManager
+from gigapaxos_tpu.wal.logger import PaxosLogger, recover
+
+
+def mk_cfg(G=8, G_reg=4, compact=False, pipeline=False, window=None):
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = G
+    cfg.paxos.register_groups = G_reg
+    cfg.paxos.compact_outbox = compact
+    cfg.paxos.pipeline_ticks = pipeline
+    if window is not None:
+        cfg.paxos.window = window
+    return cfg
+
+
+def pump(m, n):
+    for _ in range(n):
+        m.tick()
+    m.drain_pipeline()
+
+
+@pytest.mark.parametrize("compact,pipeline", [(False, False), (False, True),
+                                              (True, False), (True, True)])
+def test_mixed_planes_end_to_end(compact, pipeline):
+    """Log and register groups commit through the same composite tick in
+    every dispatch mode (full/compact x eager/pipelined)."""
+    m = PaxosManager(mk_cfg(compact=compact, pipeline=pipeline), 3,
+                     [NoopApp() for _ in range(3)])
+    assert m.create_paxos_instance("logA", [0, 1, 2])
+    assert m.create_paxos_instance("regA", [0, 1, 2], register=True)
+    acks = {}
+    for i in range(6):
+        m.propose("logA", f"L{i}".encode().ljust(40, b"x"),
+                  lambda rid, resp: acks.__setitem__(rid, resp))
+        m.propose("regA", f"R{i}".encode().ljust(40, b"x"),
+                  lambda rid, resp: acks.__setitem__(rid, resp))
+        m.tick()
+    pump(m, 20)
+    assert len(acks) == 12
+    assert all(m.exec_watermarks("logA") == 6)
+    assert all(m.exec_watermarks("regA") == 6)
+
+
+def test_register_rows_allocate_high_and_recycle():
+    m = PaxosManager(mk_cfg(G=4, G_reg=2), 3, [NoopApp() for _ in range(3)])
+    m.create_paxos_instance("r0", [0, 1, 2], register=True)
+    m.create_paxos_instance("r1", [0, 1, 2], register=True)
+    assert m.rows.row("r0") >= m.G and m.rows.row("r1") >= m.G
+    assert m.is_register_row(m.rows.row("r0"))
+    assert not m.create_paxos_instance("r2", [0, 1, 2], register=True)
+    # log pool is untouched by register allocation
+    m.create_paxos_instance("l0", [0, 1, 2])
+    assert m.rows.row("l0") < m.G
+    # freeing a register row recycles into the high pool
+    m.remove_paxos_instance("r1")
+    m.create_paxos_instance("r2", [0, 1, 2], register=True)
+    assert m.rows.row("r2") >= m.G
+
+
+def test_register_without_capacity_rejected():
+    m = PaxosManager(mk_cfg(G_reg=0), 3, [NoopApp() for _ in range(3)])
+    with pytest.raises(ValueError):
+        m.create_paxos_instance("r0", [0, 1, 2], register=True)
+
+
+def test_register_groups_negative_rejected():
+    cfg = GigapaxosTpuConfig()
+    with pytest.raises(ValueError):
+        cfg.paxos.register_groups = -1
+        cfg.paxos.__post_init__()
+
+
+def test_register_overwrite_semantics():
+    """A register group holds ONE consensus cell: decisions overwrite in
+    place (the version/exec watermark still advances monotonically), and
+    the final app state reflects the last committed write."""
+    apps = [KVApp() for _ in range(3)]
+    m = PaxosManager(mk_cfg(compact=True), 3, apps)
+    m.create_paxos_instance("reg", [0, 1, 2], register=True)
+    for i in range(10):
+        m.propose("reg", f"PUT k v{i}".encode())
+        m.tick()
+    pump(m, 10)
+    assert all(m.exec_watermarks("reg") == 10)
+    for a in apps:
+        assert a.execute("reg", b"GET k", 10**9) == b"v9"
+    # the register plane is W=1: per-group consensus state is a single
+    # cell, not a ring
+    assert m.rstate.acc_req.shape[1] == 1
+
+
+def test_register_laggard_repair_ships_register():
+    """Catch-up for a register group is a checkpoint transfer ("ship the
+    register"): a revived replica can never ring-replay (W=1 — its missed
+    versions were overwritten), so ANY lag routes through sync."""
+    apps = [KVApp() for _ in range(3)]
+    m = PaxosManager(mk_cfg(compact=True), 3, apps)
+    m.create_paxos_instance("reg", [0, 1, 2], register=True)
+    for i in range(3):
+        m.propose("reg", f"PUT k v{i}".encode())
+        m.tick()
+    pump(m, 5)
+    m.set_alive(2, False)
+    for i in range(5):
+        m.propose("reg", f"PUT k w{i}".encode())
+        m.tick()
+    pump(m, 5)
+    m.set_alive(2, True)
+    pump(m, 30)
+    ws = m.exec_watermarks("reg")
+    assert ws[2] == ws[0] == ws[1] == 8, ws
+    assert m.stats["checkpoint_transfers"] >= 1
+    assert apps[2].execute("reg", b"GET k", 10**9) == b"w4"
+
+
+@pytest.mark.parametrize("compact", [False, True])
+def test_mixed_wal_recover(tmp_path, compact):
+    """Crash + recover over mixed planes: snapshot carries both planes
+    (reg_-prefixed fields), journal replay re-drives register writes from
+    OP_REG records, and recovered watermarks + app state match the live
+    run exactly."""
+    cfg = mk_cfg(compact=compact, pipeline=True)
+    d = os.path.join(str(tmp_path), "wal")
+    wal = PaxosLogger(d, checkpoint_every_ticks=10)
+    apps = [KVApp() for _ in range(3)]
+    m = PaxosManager(cfg, 3, apps, wal=wal)
+    m.create_paxos_instance("logA", [0, 1, 2])
+    m.create_paxos_instance("regA", [0, 1, 2], register=True)
+    for i in range(25):
+        m.propose("logA", f"PUT kl v{i}".encode())
+        m.propose("regA", f"PUT kr v{i}".encode())
+        m.tick()
+    pump(m, 10)
+    want_reg = m.exec_watermarks("regA").copy()
+    want_log = m.exec_watermarks("logA").copy()
+    wal.close()
+    apps2 = [KVApp() for _ in range(3)]
+    m2 = recover(cfg, 3, apps2, d)
+    assert np.array_equal(m2.exec_watermarks("regA"), want_reg)
+    assert np.array_equal(m2.exec_watermarks("logA"), want_log)
+    for r in range(3):
+        assert apps2[r].checkpoint("regA") == apps[r].checkpoint("regA")
+        assert apps2[r].checkpoint("logA") == apps[r].checkpoint("logA")
+    # the recovered manager keeps committing to both planes
+    n0 = m2.stats["decisions"]
+    m2.propose("regA", b"PUT kr after")
+    m2.propose("logA", b"PUT kl after")
+    pump(m2, 10)
+    assert m2.stats["decisions"] >= n0 + 2
+
+
+def test_log_plane_bit_identity_with_unused_register_plane(tmp_path):
+    """A build with register_groups configured but NO register groups
+    created must be bit-identical to one with register_groups=0: same
+    log-plane state arrays, byte-identical journals."""
+    results = []
+    for g_reg, sub in ((0, "a"), (4, "b")):
+        cfg = mk_cfg(G_reg=g_reg, compact=True)
+        d = os.path.join(str(tmp_path), sub)
+        wal = PaxosLogger(d, checkpoint_every_ticks=1000)
+        m = PaxosManager(cfg, 3, [KVApp() for _ in range(3)], wal=wal)
+        m.create_paxos_instance("svc", [0, 1, 2])
+        for i in range(12):
+            m.propose("svc", f"PUT k{i} v{i}".encode())
+            m.tick()
+        pump(m, 8)
+        wal.close()
+        state = {f: np.asarray(getattr(m.state, f)) for f in m.state._fields}
+        jpaths = sorted(p for p in os.listdir(d) if p.startswith("journal."))
+        blobs = [open(os.path.join(d, p), "rb").read() for p in jpaths]
+        results.append((state, jpaths, blobs))
+    (st_a, jp_a, bl_a), (st_b, jp_b, bl_b) = results
+    for f in st_a:
+        assert np.array_equal(st_a[f], st_b[f]), f
+    assert jp_a == jp_b
+    assert bl_a == bl_b  # journals byte-identical: no OP_REG, 4-field creates
+
+
+def test_register_memory_per_group_at_least_4x_smaller():
+    """The headline claim: a register row costs >= 4x less state than a
+    log-mode W=8 row (per-group bytes across every per-group array)."""
+    from gigapaxos_tpu.paxos import state as st
+
+    def bytes_per_group(s, G):
+        return sum(np.asarray(getattr(s, f)).nbytes for f in s._fields) / G
+
+    R, G = 3, 64
+    log8 = st.init_state(R, G, 8)
+    reg = st.init_state(R, G, 1)
+    ratio = bytes_per_group(log8, G) / bytes_per_group(reg, G)
+    assert ratio >= 4.0, ratio
+
+
+def test_placement_mode_bit_round_trips():
+    from gigapaxos_tpu.placement.table import (MODE_KEY_PREFIX,
+                                               PlacementTable,
+                                               apply_placement_command)
+    from gigapaxos_tpu.reconfiguration.consistent_hashing import (
+        ConsistentHashRing)
+
+    ring = ConsistentHashRing(["s0", "s1", "s2"])
+    t = PlacementTable(ring)
+    assert not t.mode_of("counter")
+    t.set_mode("counter", register=True)
+    assert t.mode_of("counter")
+    cmd = t.to_mode_command("counter")
+    assert cmd["op"] == "placement_set_mode"
+
+    # the committed command installs the bit in the _PLACEMENT record...
+    class Rec:
+        def __init__(self):
+            self.rc_epochs = {}
+            self.epoch = 0
+
+        def to_dict(self):
+            return {"rc_epochs": dict(self.rc_epochs), "epoch": self.epoch}
+
+    records = {}
+    out = apply_placement_command(records, cmd, lambda name: Rec())
+    assert out["ok"]
+    assert records["_PLACEMENT"].rc_epochs[MODE_KEY_PREFIX + "counter"] == 1
+    # ...and a fresh table adopting the record derives the same bit
+    t2 = PlacementTable(ring)
+    t2.load_record(records["_PLACEMENT"].to_dict())
+    assert t2.mode_of("counter")
+    assert not t2.mode_of("other")
+    # clear round-trips too
+    out = apply_placement_command(
+        records, {"op": "placement_clear_mode", "name": "_PLACEMENT",
+                  "service": "counter"}, lambda name: Rec())
+    assert out["ok"]
+    t2.load_record(records["_PLACEMENT"].to_dict())
+    assert not t2.mode_of("counter")
+
+
+def test_paystore_counters_wired():
+    from gigapaxos_tpu.paxos.paystore import PayloadStore
+
+    ps = PayloadStore(cap=2)
+    body = b"y" * 64
+    ps.intern(body)
+    ps.intern(bytes(body))  # equal content -> hit
+    assert ps.hits == 1 and ps.misses == 1
+    ps.intern(b"z" * 64)
+    ps.intern(b"w" * 64)  # cap=2: evicts the LRU entry
+    assert ps.evictions >= 1

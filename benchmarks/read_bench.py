@@ -1,0 +1,201 @@
+"""Read-mostly throughput artifact for the lease plane (ISSUE 17).
+
+The tentpole claim: with leader leases folded into the fused tick, a
+95/5 read-mostly workload is served mostly from the lease holder's local
+state — no consensus round per read — so sustained op throughput beats
+the all-consensus baseline (every read a CLS_READ round through the
+ordered stream) by >= 5x on the same plane.
+
+Shape: one dense Mode A plane holding >= 100k live groups (created in
+batches, every group elects + takes a lease), with the measured 95/5
+traffic on a hot subset (realistic skew) and a uniform local-serve probe
+across the full width.  Both legs run the same ``PaxosManager.read``
+API; the baseline simply has ``read_leases`` off, which routes every
+read through the consensus fallback.  Reported per leg: ops/s, local
+read fraction, and read-latency p50/p99.  Gate: ``speedup_x >= 5`` at
+``groups >= 100_000``.
+
+Run: ``python benchmarks/read_bench.py [--json PATH] [--quick]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+if os.environ.get("GPTPU_BENCH_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["GPTPU_BENCH_PLATFORM"])
+
+import numpy as np  # noqa: E402
+
+R = 3
+READ_FRAC = 0.95  # exactly 19 reads per write (i % 20 != 0)
+
+
+def build(leases: bool, groups: int, batch: int = 8192):
+    from gigapaxos_tpu.config import GigapaxosTpuConfig
+    from gigapaxos_tpu.models.replicable import KVApp
+    from gigapaxos_tpu.paxos.manager import PaxosManager
+
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = groups
+    cfg.paxos.compact_outbox = True
+    cfg.paxos.window = 8
+    cfg.paxos.read_leases = leases
+    cfg.paxos.lease_ticks = 64
+    cfg.paxos.lease_margin_ticks = 8
+    m = PaxosManager(cfg, R, [KVApp() for _ in range(R)])
+    names = [f"g{i}" for i in range(groups)]
+    for i in range(0, groups, batch):
+        m.create_paxos_instances(names[i:i + batch], [0, 1, 2])
+    return m, names
+
+
+def drain(m, pending, max_spins=20000):
+    spins = 0
+    while pending[0] > 0 and spins < max_spins:
+        m.tick()
+        m.drain_pipeline()
+        spins += 1
+    return spins
+
+
+def warm(m, hot_names):
+    """Seed the hot set (one committed write each) and let leases grant."""
+    pending = [0]
+
+    def cb(r, resp):
+        pending[0] -= 1
+    for n in hot_names:
+        pending[0] += 1
+        m.propose(n, b"PUT k 0", cb)
+    drain(m, pending)
+    m.tick()
+    m.drain_pipeline()
+
+
+def run_leg(m, hot_names, ops_per_round, rounds, seed=0):
+    """The measured 95/5 closed-loop: issue a round of ops against the
+    hot set, then drive ticks until every callback has fired."""
+    rng = np.random.default_rng(seed)
+    lat = []
+    pending = [0]
+    reads = writes = 0
+    local0 = m.stats["local_reads"]
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        gidx = rng.integers(0, len(hot_names), size=ops_per_round)
+        for i in range(ops_per_round):
+            name = hot_names[int(gidx[i])]
+            if i % 20 == 0:  # the 5% write share
+                writes += 1
+                pending[0] += 1
+
+                def wcb(r, resp, _p=pending):
+                    _p[0] -= 1
+                m.propose(name, b"PUT k w", wcb)
+            else:
+                reads += 1
+                pending[0] += 1
+                ts = time.perf_counter()
+
+                def rcb(r, resp, _p=pending, _ts=ts, _lat=lat):
+                    _p[0] -= 1
+                    _lat.append(time.perf_counter() - _ts)
+                m.read(name, b"GET k", rcb)
+        drain(m, pending)
+    dt = time.perf_counter() - t0
+    lat_ms = np.sort(np.array(lat)) * 1e3
+    done = reads + writes - pending[0]
+    return {
+        "ops": reads + writes,
+        "completed": int(done),
+        "reads": reads,
+        "writes": writes,
+        "seconds": round(dt, 3),
+        "ops_per_s": round(done / dt, 1),
+        "local_reads": int(m.stats["local_reads"] - local0),
+        "local_read_fraction": round(
+            (m.stats["local_reads"] - local0) / max(reads, 1), 4),
+        "read_p50_ms": round(float(lat_ms[len(lat_ms) // 2]), 4),
+        "read_p99_ms": round(float(lat_ms[int(len(lat_ms) * 0.99)]), 4),
+    }
+
+
+def uniform_probe(m, names, n=4096, seed=1):
+    """Local-serve fraction across the FULL plane width: every created
+    group elects and takes a lease, so uniform reads serve locally too."""
+    rng = np.random.default_rng(seed)
+    local0 = m.stats["local_reads"]
+    pending = [0]
+
+    def cb(r, resp):
+        pending[0] -= 1
+    for i in rng.integers(0, len(names), size=n):
+        pending[0] += 1
+        m.read(names[int(i)], b"GET k", cb)
+    drain(m, pending)
+    return {
+        "reads": int(n),
+        "local_fraction": round((m.stats["local_reads"] - local0) / n, 4),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=None,
+                    help="also write the artifact to this path")
+    ap.add_argument("--groups", type=int, default=1 << 17,
+                    help="live groups on the plane (gate needs >= 100k)")
+    ap.add_argument("--hot", type=int, default=256,
+                    help="hot-set size carrying the 95/5 traffic")
+    ap.add_argument("--ops", type=int, default=1 << 15,
+                    help="ops per measured round")
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--quick", action="store_true",
+                    help="small shapes for smoke testing")
+    args = ap.parse_args()
+    if args.quick:
+        args.groups, args.hot = 1 << 12, 64
+        args.ops, args.rounds = 1 << 11, 2
+
+    legs = {}
+    for leases, key in ((True, "leases"), (False, "all_consensus")):
+        m, names = build(leases, args.groups)
+        hot = names[:args.hot]
+        warm(m, hot)
+        legs[key] = run_leg(m, hot, args.ops, args.rounds)
+        if leases:
+            legs["uniform_probe"] = uniform_probe(m, names)
+        del m
+
+    speedup = legs["leases"]["ops_per_s"] / legs["all_consensus"]["ops_per_s"]
+    result = {
+        "metric": "read_mostly_95_5_speedup_over_all_consensus",
+        "value": round(speedup, 2),
+        "unit": "x ops/s (gate >= 5x at >= 100k groups)",
+        "platform": jax.devices()[0].platform,
+        "groups": args.groups,
+        "hot_groups": args.hot,
+        "read_fraction": READ_FRAC,
+        "leases": legs["leases"],
+        "all_consensus": legs["all_consensus"],
+        "uniform_probe": legs["uniform_probe"],
+        "gate_pass": bool(speedup >= 5.0 and args.groups >= 100_000),
+    }
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=1)
+        result["written"] = args.json
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

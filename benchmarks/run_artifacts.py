@@ -395,6 +395,28 @@ def bench_register() -> dict:
     }
 
 
+def bench_reads() -> dict:
+    """Lease-plane read artifact (benchmarks/read_bench.py): refreshes
+    results_reads_pr17.json — 95/5 read-mostly closed loop on a >= 100k
+    group plane, leases on vs the all-consensus baseline (hard gate:
+    >= 5x ops/s), plus local-read fraction and read p50/p99."""
+    r = _script(["benchmarks/read_bench.py", "--json",
+                 "benchmarks/results_reads_pr17.json"], timeout=3600)[-1]
+    if not r["gate_pass"]:
+        raise RuntimeError(
+            f"read-mostly gate failed: {r['value']}x < 5x "
+            f"at {r['groups']} groups")
+    return {
+        "metric": r["metric"],
+        "value": r["value"],
+        "unit": r["unit"],
+        "local_read_fraction": r["leases"]["local_read_fraction"],
+        "read_p50_ms": r["leases"]["read_p50_ms"],
+        "read_p99_ms": r["leases"]["read_p99_ms"],
+        "artifact": r.get("written"),
+    }
+
+
 def bench_cells_capacity() -> dict:
     """Serving-cells capacity sweep (benchmarks/cells_capacity.py):
     refreshes results_capacity_cells_pr8.json (1 -> 2 -> 4 cells with
@@ -482,6 +504,8 @@ def main() -> None:
     run("overload", bench_overload)
     # register plane (PR 16): W=1 RMW groups — per-group memory gate
     run("register", bench_register)
+    # lease plane (PR 17): linearizable local reads — 95/5 speedup gate
+    run("reads", bench_reads)
 
     out = args.out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),

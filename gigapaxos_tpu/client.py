@@ -130,6 +130,15 @@ class ReconfigurableAppClient:
         self._batch_lat_h = _obs_registry().histogram(
             "client_batch_rtt_seconds",
             help="per-batch-frame round-trip latency")
+        #: read-latency SLO histogram (ISSUE 17): lease-local reads answer
+        #: without a consensus round, so reads get their own distribution
+        #: instead of polluting the commit-latency one
+        self._read_lat_h = _obs_registry().histogram(
+            "client_read_latency_seconds",
+            help="client-observed read request->response latency")
+        #: rids in flight on the READ path (routes the RTT sample to the
+        #: read histogram; bounded by the same reaping as _sent_at)
+        self._read_rids: set = set()
         #: cross-process tracing: when enabled (GPTPU_REQTRACE, or set
         #: ``client.trace.enabled = True``), app requests carry a trace id
         #: on the wire ("trace") that every hop records against — see
@@ -200,7 +209,11 @@ class ReconfigurableAppClient:
                     del self._sent_at[rid]
                     node, t0 = sa
                     rtt = time.monotonic() - t0
-                    self._lat_h.observe(rtt)
+                    if rid in self._read_rids:
+                        self._read_rids.discard(rid)
+                        self._read_lat_h.observe(rtt)
+                    else:
+                        self._lat_h.observe(rtt)
                     prev = self._rtt.get(node)
                     self._rtt[node] = rtt if prev is None else 0.875 * prev + 0.125 * rtt
                 tid = self._trace_ids.pop(rid, None)
@@ -236,6 +249,7 @@ class ReconfigurableAppClient:
             self._callbacks.pop(rid, None)
             self._cb_deadline.pop(rid, None)
             self._trace_ids.pop(rid, None)
+            self._read_rids.discard(rid)
 
     def _await(self, rid: int, timeout: float) -> dict:
         deadline = time.monotonic() + timeout
@@ -586,6 +600,38 @@ class ReconfigurableAppClient:
         self.m.send(target, self._stamp(p), cls=_overload.CLS_CLIENT)
         return rid
 
+    def send_read(
+        self,
+        name: str,
+        payload: bytes,
+        callback: Callable[[dict], None],
+        active: Optional[str] = None,
+    ) -> int:
+        """Fire one linearizable READ (ISSUE 17): travels CLS_READ end to
+        end and is answered from the lease holder's local state when the
+        server's lease validates (response carries ``local: true``), else
+        through a consensus round.  ``payload`` must be side-effect-free
+        under the app.  The callback gets the raw response packet."""
+        target = active or self._route(name, self.request_actives(name))
+        rid = self._rid()
+        now = time.monotonic()
+        with self._lock:
+            if len(self._callbacks) > 4096:
+                dead = [r for r, d in self._cb_deadline.items() if d < now]
+                for r in dead:
+                    self._callbacks.pop(r, None)
+                    self._cb_deadline.pop(r, None)
+                    self._sent_at.pop(r, None)
+                    self._read_rids.discard(r)
+            self._callbacks[rid] = callback
+            self._cb_deadline[rid] = now + self._cb_ttl_s
+            self._sent_at[rid] = (target, now)
+            self._read_rids.add(rid)
+        p = pkt.app_read(name, payload, rid)
+        p["deadline"] = self._wire_deadline()
+        self.m.send(target, self._stamp(p), cls=_overload.CLS_READ)
+        return rid
+
     def _batch_rtt(self, bid) -> None:
         """Per-frame RTT sample for the redirector's EWMA."""
         ent = None
@@ -682,8 +728,19 @@ class ReconfigurableAppClient:
             self.m.send(target, self._stamp(p), cls=_overload.CLS_CLIENT)
         return rids
 
+    def read(self, name: str, payload: bytes = b"", timeout: float = 15.0,
+             tries: int = 4) -> bytes:
+        """Sync linearizable read (ISSUE 17): :meth:`request`'s
+        redirection/retry loop over the CLS_READ wire path.  Lease-local
+        on the server when valid, consensus fallback otherwise — either
+        way the answer reflects every acked write.  ``payload`` must be
+        side-effect-free under the app (it may execute once locally or R
+        times via the fallback; retries are harmless)."""
+        return self.request(name, payload, timeout, tries,
+                            _mk=pkt.app_read, _cls=_overload.CLS_READ)
+
     def request(self, name: str, payload: bytes, timeout: float = 15.0,
-                tries: int = 4) -> bytes:
+                tries: int = 4, _mk=None, _cls=None) -> bytes:
         """Sync request with redirection: on not_active/stopped, invalidate
         the cache, re-resolve and retry (the client's reconfiguration-chase
         loop).
@@ -697,7 +754,12 @@ class ReconfigurableAppClient:
         """
         per = max(timeout / tries, 0.5)
         last = "timeout"
+        mk = _mk or pkt.app_request
+        cls = _overload.CLS_CLIENT if _cls is None else _cls
         rid = self._rid()  # one rid for every attempt (retransmission dedup)
+        if cls == _overload.CLS_READ:
+            with self._lock:
+                self._read_rids.add(rid)  # RTT sample -> read histogram
         # one wire deadline for the whole request: every attempt carries it,
         # and any stage that sees it expired drops the work instead of
         # finishing it for a caller that already gave up
@@ -724,9 +786,9 @@ class ReconfigurableAppClient:
                 target = self._route(name, actives, avoid=bad)
                 with self._lock:
                     self._sent_at[rid] = (target, time.monotonic())
-                p = pkt.app_request(name, payload, rid)
+                p = mk(name, payload, rid)
                 p["deadline"] = wire_deadline
-                self.m.send(target, self._stamp(p), cls=_overload.CLS_CLIENT)
+                self.m.send(target, self._stamp(p), cls=cls)
                 try:
                     resp = self._await(rid, per)
                 except TimeoutError:

@@ -190,6 +190,21 @@ class PaxosTuning:
     # Mode B only (Mode A elections already complete same-tick); default
     # off — the legacy election path is bit-identical when disabled.
     fast_reelection: bool = False
+    # Leader leases (ISSUE 17): a lease-holding replica answers reads
+    # locally (no consensus round) iff its lease is valid AND the group is
+    # quiescent (executed frontier == accepted frontier).  Lease state is
+    # dense [G] device columns folded inside the fused tick; time is the
+    # tick clock itself, so lease decisions replay deterministically from
+    # the WAL.  Default off — the lease-off build runs the literal
+    # pre-lease tick program, bit for bit (the register_groups=0 pattern).
+    read_leases: bool = False
+    # Lease horizon in ticks: a grant/renewal is valid for this many ticks.
+    lease_ticks: int = 64
+    # Skew margin in ticks: a coordinator other than the holder may not
+    # admit new writes until margin ticks past expiry, so a holder whose
+    # clock runs up to margin ticks slow still stops serving reads before
+    # any conflicting write can be acked.
+    lease_margin_ticks: int = 8
     # Tick coalescing: minimum spacing between driver ticks while busy.
     # Each tick has a fixed host cost (admission, placement, compaction
     # unpack); spacing ticks lets requests accumulate so that cost
@@ -206,6 +221,15 @@ class PaxosTuning:
         if self.register_groups < 0:
             raise ValueError(
                 f"register_groups must be >= 0, got {self.register_groups}"
+            )
+        if self.read_leases and self.lease_ticks < 1:
+            raise ValueError(
+                f"lease_ticks must be >= 1, got {self.lease_ticks}"
+            )
+        if self.lease_margin_ticks < 0:
+            raise ValueError(
+                f"lease_margin_ticks must be >= 0, got "
+                f"{self.lease_margin_ticks}"
             )
         if self.compact_outbox and self.proposals_per_tick > 31:
             # taken_bits packs the P intake slots into one int32 lane
@@ -423,6 +447,10 @@ class OverloadConfig:
     # of ``paxos.send_queue_cap`` (control class keeps the full cap, so
     # liveness traffic always has headroom a client flood cannot take).
     client_queue_frac: float = 0.75
+    # Transport send-queue budget for read-class frames (ISSUE 17): reads
+    # get their own bounded lane so a read flood backpressures reads, not
+    # writes (and control stays untouched as ever).
+    read_queue_frac: float = 0.5
 
     def __post_init__(self) -> None:
         if self.intake_hi < 2:
@@ -444,6 +472,10 @@ class OverloadConfig:
             raise ValueError(
                 f"overload.client_queue_frac must be in (0, 1], got "
                 f"{self.client_queue_frac}")
+        if not (0.0 < self.read_queue_frac <= 1.0):
+            raise ValueError(
+                f"overload.read_queue_frac must be in (0, 1], got "
+                f"{self.read_queue_frac}")
 
 
 @dataclass

@@ -253,6 +253,35 @@ class ModeBNode(ModeBCommon):
                                      cfg.overload.intake_lo,
                                      node=self._ov_node)
             if cfg.overload.enabled else None)
+        # ---- host-side read leases (ISSUE 17, pragmatic Mode-B twin) ----
+        # Mode A folds leases on device; a per-process node instead keeps
+        # tick-denominated host bookkeeping over its completed-tick
+        # coordinator view: holdership renews every completed tick we
+        # remain the winning coordinator, and a takeover write-fences the
+        # row for a full horizon+margin (we cannot see the prior holder's
+        # grant time, so we wait out the worst case).  Semantics are
+        # deliberately conservative; leases default OFF.
+        self._read_leases = bool(cfg.paxos.read_leases)
+        self._lease_horizon = int(cfg.paxos.lease_ticks)
+        self._lease_margin = int(cfg.paxos.lease_margin_ticks)
+        self._lease_until = np.zeros(self.G, np.int64)  # our holdership expiry
+        self._lease_fence = np.zeros(self.G, np.int64)  # takeover write fence
+        self._lease_prev_coord = np.full(self.G, np.int32(-1))
+        # renewal requires recent MAJORITY contact, not just local belief:
+        # a partitioned stale coordinator still names itself in its own
+        # view forever, and without this gate it would keep serving local
+        # reads while the majority side elects and writes
+        self._last_heard = np.zeros(self.R, np.int64)  # slot -> last rx tick
+        from ..obs.metrics import registry as _obsreg2
+
+        self._reads_local_c = _obsreg2().counter(
+            "reads_local_total",
+            help="reads answered locally under a valid lease (no consensus "
+                 "round)", node=self._ov_node)
+        self._reads_fallback_c = _obsreg2().counter(
+            "reads_fallback_total",
+            help="reads that fell back to a consensus round (no/invalid "
+                 "lease or non-quiescent group)", node=self._ov_node)
         self.lock = ContendedLock()
         # ---- device-resident application (models/device_kv.py) ----
         # The per-process deployment twin of Mode A's device_app
@@ -301,6 +330,7 @@ class ModeBNode(ModeBCommon):
         prev = d.bytes_handler
 
         def on_bytes(sender: str, payload: bytes) -> None:
+            self._heard(sender)
             if payload.startswith(wire.RELAY_MAGIC):
                 self._on_relay(sender, payload)
             elif payload.startswith(wire.BATCH_MAGIC):
@@ -320,14 +350,35 @@ class ModeBNode(ModeBCommon):
                 prev(sender, payload)
 
         d.bytes_handler = on_bytes
-        self.m.register(MB_PROPOSAL, self._on_proposal)
-        self.m.register(MB_UNDIGEST, self._on_undigest)
-        self.m.register(MB_UNDIGEST_REPLY, self._on_undigest_reply)
-        self.m.register(MB_WHOIS, self._on_whois)
-        self.m.register(MB_WHOIS_REPLY, self._on_whois_reply)
-        self.m.register(MB_SYNC_REQ, self._on_sync_req)
-        self.m.register(MB_CKPT_REQ, self._on_ckpt_req)
-        self.m.register(MB_CKPT, self._on_ckpt)
+
+        def _reg(mtype, handler):
+            def wrapped(sender, p, _h=handler):
+                self._heard(sender)
+                return _h(sender, p)
+            self.m.register(mtype, wrapped)
+
+        _reg(MB_PROPOSAL, self._on_proposal)
+        _reg(MB_UNDIGEST, self._on_undigest)
+        _reg(MB_UNDIGEST_REPLY, self._on_undigest_reply)
+        _reg(MB_WHOIS, self._on_whois)
+        _reg(MB_WHOIS_REPLY, self._on_whois_reply)
+        _reg(MB_SYNC_REQ, self._on_sync_req)
+        _reg(MB_CKPT_REQ, self._on_ckpt_req)
+        _reg(MB_CKPT, self._on_ckpt)
+
+    def _heard(self, sender: str) -> None:
+        """Record peer contact for the lease renewal quorum gate."""
+        try:
+            s = self.members.index(sender)
+        except ValueError:
+            return
+        if s >= self._last_heard.shape[0]:
+            # universe expansion grew the membership past the array sized
+            # at init — a new member's first frame must not raise here
+            self._last_heard = np.concatenate([
+                self._last_heard,
+                np.zeros(s + 1 - self._last_heard.shape[0], np.int64)])
+        self._last_heard[s] = self.tick_num
 
     # ------------------------------------------------------------------ admin
     def create_group(self, name: str, members: List[int], epoch: int = 0,
@@ -659,7 +710,7 @@ class ModeBNode(ModeBCommon):
                 if callback is not None:
                     self._held_callbacks.append((callback, -1, None))
             return None
-        if (cls == _overload.CLS_CLIENT and self.overload is not None
+        if (cls != _overload.CLS_CONTROL and self.overload is not None
                 and not self.overload.admit(cls)):
             # watermark shed: explicit retriable busy NACK, never silent
             self.stats["shed_requests"] += 1
@@ -987,6 +1038,13 @@ class ModeBNode(ModeBCommon):
                             "payload": payload.hex(), "stop": stop,
                         })
                 continue
+            if (self._read_leases
+                    and self.tick_num < int(self._lease_fence[row])):
+                # takeover write fence (ISSUE 17): a freshly-won row's
+                # proposals stay queued until the prior holder's lease has
+                # provably run out — delay, never refusal (the fence only
+                # gates NEW intake; journal-replayed inboxes are immune)
+                continue
             take = []
             p = 0
             while q and p < self.P:
@@ -1048,6 +1106,8 @@ class ModeBNode(ModeBCommon):
                 self._complete_tick(p_out, p_placed, p_extras)
 
     def _process_outbox(self, out, placed=None, extras=None) -> None:
+        if self._read_leases:
+            self._lease_fold(np.asarray(out.coord_id))
         self._coord_view = out.coord_id
         taken = out.intake_taken[self.r]  # [P, G]
         for row, take in (self._placed if placed is None else placed):
@@ -1083,6 +1143,94 @@ class ModeBNode(ModeBCommon):
                                   int(eb[row]) + j, bool(es[j, row]),
                                   response=r_bytes)
         self.stats["decisions"] += int(np.asarray(out.decided_now).sum())
+
+    def _lease_fold(self, coord: np.ndarray) -> None:
+        """Tick-denominated lease bookkeeping over the completed tick's
+        coordinator view (runs before _coord_view adopts it, so the
+        PREVIOUS view is still visible for takeover detection).
+
+        Renewal: while we remain a row's winning coordinator, holdership
+        extends to (majority-contact time) + horizon, where the contact
+        time is the freshest tick at which a MAJORITY of the row's
+        members (self included) had been heard from.  Anchoring at the
+        evidence rather than local now is the classic lease discipline:
+        a connected coordinator's lease never lapses, while a partitioned
+        one's expires exactly one horizon after it last held a quorum —
+        even though its own view still names it coordinator — which is
+        strictly before a successor's horizon+margin takeover fence ends.
+
+        Takeover: a row whose coordinatorship moved TO us is write-fenced
+        for horizon+margin ticks.  The fence applies even when the prior
+        view is unknown (prev == -1: bootstrap election, WAL recovery,
+        whois late-join) — a node cannot locally distinguish group birth
+        from missed history, and an unfenced post-recovery takeover would
+        admit writes while the real prior holder still serves reads.
+        Write delay at genuine birth is the price of that safety."""
+        now = self.tick_num
+        ours = coord == self.r
+        if ours.any():
+            heard = self._last_heard.copy()
+            if self.r >= heard.shape[0]:  # post-expansion membership growth
+                heard = np.concatenate([
+                    heard, np.zeros(self.r + 1 - heard.shape[0], np.int64)])
+            heard[self.r] = now
+            for row in np.nonzero(ours)[0]:
+                meta = self._row_meta.get(int(row))
+                if meta is None:
+                    continue
+                members = list(meta[1])
+                k = len(members) // 2 + 1
+                t_q = sorted(
+                    (int(heard[s]) if s < heard.shape[0] else 0
+                     for s in members), reverse=True)[k - 1]
+                self._lease_until[row] = t_q + self._lease_horizon
+        took = ours & (self._lease_prev_coord != self.r)
+        if took.any():
+            self._lease_fence[took] = np.maximum(
+                self._lease_fence[took],
+                now + self._lease_horizon + self._lease_margin)
+        self._lease_prev_coord = coord.astype(np.int32, copy=True)
+
+    def read(
+        self,
+        name: str,
+        payload: bytes = b"",
+        callback: Optional[Callable[[int, Optional[bytes]], None]] = None,
+        deadline: Optional[int] = None,
+    ) -> Optional[int]:
+        """Linearizable read (ISSUE 17, Mode-B twin of
+        paxos/manager.read).  Local iff we hold the row's lease (winning
+        coordinator within the renewal horizon, past any takeover fence)
+        AND the row is quiescent at us: nothing queued or stalled and our
+        executed frontier equals our assignment frontier, so every acked
+        write is already applied locally.  Otherwise the read rides a
+        CLS_READ propose through the ordered stream.  ``payload`` must be
+        side-effect-free under the app; local reads use rid 0 and fire
+        the callback synchronously."""
+        if deadline is not None and _overload.expired(deadline):
+            _overload.count_expired("intake", self._ov_node)
+            if callback is not None:
+                callback(_overload.RID_EXPIRED, None)
+            return None
+        row = self.rows.row(name)
+        if (self._read_leases and row is not None
+                and row not in self._stopped_rows
+                and row not in self._stalled
+                and int(self._coord_view[row]) == self.r
+                and self.tick_num < int(self._lease_until[row])
+                and self.tick_num >= int(self._lease_fence[row])
+                and not self._queues.get(row)
+                and int(self.state.next_slot[self.r, row])
+                == int(self.state.exec_slot[self.r, row])):
+            resp = self.app.execute(name, payload, 0)
+            self._reads_local_c.inc()
+            self.stats["local_reads"] += 1
+            if callback is not None:
+                callback(0, resp)
+            return 0
+        self._reads_fallback_c.inc()
+        return self.propose(name, payload, callback, deadline=deadline,
+                            cls=_overload.CLS_READ)
 
     def _execute_one(self, row: int, name: str, rid: int, slot: int,
                      is_stop: bool, response: Optional[bytes] = None) -> None:

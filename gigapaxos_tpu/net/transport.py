@@ -176,9 +176,11 @@ class _Peer:
     def __init__(self, transport: "Transport", dest: str):
         self.t = transport
         self.dest = dest
-        #: per-class bounded deques, indexed by CLS_CONTROL / CLS_CLIENT;
-        #: drain priority is index order (control first)
-        self.dq = (collections.deque(), collections.deque())
+        #: per-class bounded deques, indexed by CLS_CONTROL / CLS_CLIENT /
+        #: CLS_READ; drain priority is index order (control first, then
+        #: writes, then reads)
+        self.dq = (collections.deque(), collections.deque(),
+                   collections.deque())
         self.caps = transport.class_caps
         self.sock: Optional[socket.socket] = None
         #: bumped by Transport.reset_peer; frames are stamped with the
@@ -336,18 +338,22 @@ class Transport:
         coalesce_bytes: int = 8 * 1024 * 1024,
         reuse_port: bool = False,
         client_queue_frac: float = 0.75,
+        read_queue_frac: float = 0.5,
     ):
         self.node_id = node_id
         self.demux = demux
         self.resolve = resolve
         self.send_queue_cap = send_queue_cap
-        #: per-class send budgets (ISSUE 14): control keeps the full cap;
-        #: client-class frames get a smaller, separate budget so a client
-        #: flood sheds client frames and can never crowd out liveness
-        #: traffic (overload must not read as node death to the FD plane)
+        #: per-class send budgets (ISSUE 14/17): control keeps the full
+        #: cap; client-class (write) and read-class frames each get a
+        #: smaller, separate budget so a flood of either sheds only its
+        #: own class — reads can never crowd out writes, and neither can
+        #: crowd out liveness traffic (overload must not read as node
+        #: death to the FD plane)
         self.class_caps = (
             send_queue_cap,
             max(1, int(send_queue_cap * client_queue_frac)),
+            max(1, int(send_queue_cap * read_queue_frac)),
         )
         self.connect_timeout_s = connect_timeout_s
         self.max_connect_attempts = max_connect_attempts

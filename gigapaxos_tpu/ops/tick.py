@@ -119,9 +119,69 @@ def _lexmax(n, c, axis):
     return jnp.squeeze(nmax, axis=axis), cmax
 
 
+class LeaseState(NamedTuple):
+    """Leader-lease columns (ISSUE 17): dense ``[G]`` lease state folded
+    inside the fused tick, so grant/renew/expiry piggyback on the
+    accept/heartbeat traffic the tick already emits — no per-group host
+    work, vmapped across every group like everything else.
+
+    Time is the lease clock itself: one tick = one unit, advanced inside
+    the fold, so lease decisions are a pure function of (state, inbox)
+    and WAL replay reproduces them bit for bit.
+
+    clock:  int32 []   — lease clock; +1 per tick.
+    holder: int32 [G]  — replica id holding the read lease (-1 = none).
+    epoch:  int32 [G]  — grant counter; bumps whenever the holder changes.
+    until:  int32 [G]  — expiry tick; reads are valid while clock < until.
+    margin: int32 [G]  — skew allowance: a DIFFERENT coordinator may not
+            admit new writes until ``clock >= until + margin``, so a
+            holder whose clock runs up to ``margin`` ticks slow still
+            stops serving reads before any conflicting write can be
+            acked (the write-side fence of the classic lease argument).
+    """
+
+    clock: jnp.ndarray
+    holder: jnp.ndarray
+    epoch: jnp.ndarray
+    until: jnp.ndarray
+    margin: jnp.ndarray
+
+
+#: lease_pack row indices (the [5, G] per-plane host summary emitted by the
+#: lease tick variants — ONE device->host pull per plane per tick)
+LP_HOLDER, LP_EPOCH, LP_UNTIL, LP_ASN, LP_WAIT = range(5)
+LP_ROWS = 5
+
+
+def init_lease(n_groups: int, margin_ticks: int = 0) -> LeaseState:
+    return LeaseState(
+        clock=jnp.zeros((), I32),
+        holder=jnp.full((n_groups,), -1, I32),
+        epoch=jnp.zeros((n_groups,), I32),
+        until=jnp.zeros((n_groups,), I32),
+        margin=jnp.full((n_groups,), margin_ticks, I32),
+    )
+
+
+def _lease_clear_rows_impl(lease: LeaseState, rows):
+    """Drop leases on the given rows (row lifecycle: create/remove/pause,
+    placement migration).  Out-of-range rows (padding) are dropped."""
+    return lease._replace(
+        holder=lease.holder.at[rows].set(-1, mode="drop"),
+        epoch=lease.epoch.at[rows].set(0, mode="drop"),
+        until=lease.until.at[rows].set(0, mode="drop"),
+    )
+
+
+#: O(rows) scatter; the manager pads rows to power-of-two buckets so row
+#: lifecycle events reuse a handful of compiles.
+lease_clear_rows = jax.jit(_lease_clear_rows_impl, donate_argnums=(0,))
+
+
 def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
                     exec_budget: int = 0, group_axis: str | None = None,
-                    fast_elect: bool = False):
+                    fast_elect: bool = False, lease: LeaseState | None = None,
+                    lease_horizon: int = 0):
     """Un-jitted tick body (jit/shard it yourself; `paxos_tick` below is the
     ready-made single-program jit with state donation).
 
@@ -403,6 +463,20 @@ def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
         alive[:, None, None], (R, P, G)
     ).reshape(RP, G)
     group_open = has_coord & jnp.any(is_win & is_active, axis=0)
+    if lease is not None:
+        # ---- lease write fence (ISSUE 17) ----
+        # A coordinator that is NOT the lease holder may not admit new
+        # writes until the prior lease has expired past its skew margin:
+        # blocking intake here blocks slot assignment, so no write the
+        # holder has not itself assigned (and thus counted into its
+        # accepted frontier) can ever be acked while local reads are
+        # still legal at the holder.  Already-assigned proposals keep
+        # pushing — they are covered by the holder's frontier.
+        lclock = lease.clock + 1
+        lease_expired = lclock >= lease.until + lease.margin
+        fence_ok = (lease.holder < 0) | (lease.holder == w_c) | lease_expired
+        lease_wait = group_open & ~fence_ok
+        group_open = group_open & fence_ok
     valid_in = (req_flat != NO_REQUEST) & src_alive & group_open[None, :]
     # FIFO admission without a sort (argsort over the request axis was ~2/3
     # of the whole tick on TPU): rank each valid entry by prefix count —
@@ -762,6 +836,31 @@ def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
         donor_exec=jnp.where(d_ok, d_exec, 0),
         donor_status=jnp.where(d_ok, d_status, 0),
     )
+    if lease is not None:
+        # ---- lease grant/renew fold (ISSUE 17) ----
+        # Renewal piggybacks on the accept traffic this same tick pushed:
+        # the effective winner keeps its lease alive just by staying the
+        # winner.  A grant needs the previous lease gone (never held, or
+        # expired past margin) — a dead holder's lease simply runs out.
+        renew = has_coord & (lease.holder == w_c)
+        grant = has_coord & ~renew & ((lease.holder < 0) | lease_expired)
+        l_holder = jnp.where(grant, w_c, lease.holder)
+        l_epoch = jnp.where(grant, lease.epoch + 1, lease.epoch)
+        l_until = jnp.where(renew | grant,
+                            lclock + jnp.int32(lease_horizon), lease.until)
+        new_lease = LeaseState(lclock, l_holder, l_epoch, l_until,
+                               lease.margin)
+        # accepted frontier: max assigned slot over MEMBER rows (dead
+        # included — a dead ex-coordinator's assignments are still
+        # accepted facts).  The host's local-read validity check compares
+        # the holder's executed watermark against this, both as-of the
+        # same tick, so a read is served locally only when the holder has
+        # executed every write any coordinator ever assigned (quiescent).
+        asn = jnp.max(jnp.where(member, new_state.next_slot, 0), axis=0)
+        lease_pack = jnp.stack([
+            l_holder, l_epoch, l_until, asn, lease_wait.astype(I32),
+        ])
+        return new_state, outbox, new_lease, lease_pack
     return new_state, outbox
 
 
@@ -841,6 +940,26 @@ def _paxos_tick_packed_impl(state, inbox: TickInbox, own_row: int = -1,
 #: that ticked with a budget must evolve state identically.
 paxos_tick_packed = jax.jit(
     _paxos_tick_packed_impl, donate_argnums=(0,), static_argnums=(2, 3, 4)
+)
+
+
+def _paxos_tick_packed_lease_impl(state, lease: LeaseState, inbox: TickInbox,
+                                  own_row: int = -1, exec_budget: int = 0,
+                                  lease_horizon: int = 0,
+                                  fast_elect: bool = False):
+    state, out, lease, lp = paxos_tick_impl(
+        state, inbox, own_row, exec_budget, fast_elect=fast_elect,
+        lease=lease, lease_horizon=lease_horizon)
+    return state, lease, pack_outbox_impl(out), lp
+
+
+#: lease twin of paxos_tick_packed: same tick + the lease fold, returning
+#: the new LeaseState and the [5, G] lease_pack host summary.  A build with
+#: read_leases off never calls this — the lease-off program is the literal
+#: pre-lease function above, bit for bit.
+paxos_tick_packed_lease = jax.jit(
+    _paxos_tick_packed_lease_impl, donate_argnums=(0, 1),
+    static_argnums=(3, 4, 5, 6),
 )
 
 
@@ -959,6 +1078,25 @@ def _paxos_tick_compact_impl(state, inbox: TickInbox, own_row: int,
 #: O(budget) device->host buffer
 paxos_tick_compact = jax.jit(
     _paxos_tick_compact_impl, donate_argnums=(0,), static_argnums=(2, 3, 4, 5)
+)
+
+
+def _paxos_tick_compact_lease_impl(state, lease: LeaseState,
+                                   inbox: TickInbox, own_row: int,
+                                   exec_budget: int, lag_budget: int,
+                                   lease_horizon: int,
+                                   fast_elect: bool = False):
+    state, out, lease, lp = paxos_tick_impl(
+        state, inbox, own_row, exec_budget, fast_elect=fast_elect,
+        lease=lease, lease_horizon=lease_horizon)
+    return state, lease, _compact_outbox_impl(out, exec_budget, lag_budget), lp
+
+
+#: lease twin of paxos_tick_compact (the at-scale path): the O(budget)
+#: compact buffer plus the O(G) lease_pack — still one dispatch, two pulls.
+paxos_tick_compact_lease = jax.jit(
+    _paxos_tick_compact_lease_impl, donate_argnums=(0, 1),
+    static_argnums=(3, 4, 5, 6, 7),
 )
 
 
@@ -1169,6 +1307,31 @@ paxos_tick_mixed_packed = jax.jit(
 )
 
 
+def _paxos_tick_mixed_packed_lease_impl(state, rstate, lease, rlease,
+                                        inbox: TickInbox, own_row: int = -1,
+                                        exec_budget: int = 0,
+                                        lease_horizon: int = 0):
+    """Lease twin of the mixed packed tick: each plane folds its own
+    LeaseState (register groups are first-class lease targets — their W=1
+    quiescence test is exactly the same frontier comparison)."""
+    g_log = state.exec_slot.shape[1]
+    ib_l, ib_r = _split_inbox(inbox, g_log)
+    state, out_l, lease, lp_l = paxos_tick_impl(
+        state, ib_l, own_row, exec_budget, lease=lease,
+        lease_horizon=lease_horizon)
+    rstate, out_r, rlease, lp_r = paxos_tick_impl(
+        rstate, ib_r, own_row, exec_budget, lease=rlease,
+        lease_horizon=lease_horizon)
+    return (state, rstate, lease, rlease,
+            pack_outbox_impl(out_l), pack_outbox_impl(out_r), lp_l, lp_r)
+
+
+paxos_tick_mixed_packed_lease = jax.jit(
+    _paxos_tick_mixed_packed_lease_impl, donate_argnums=(0, 1, 2, 3),
+    static_argnums=(5, 6, 7),
+)
+
+
 def _paxos_tick_mixed_compact_impl(state, rstate, inbox: TickInbox,
                                    own_row: int, exec_budget: int,
                                    lag_budget: int):
@@ -1187,6 +1350,29 @@ def _paxos_tick_mixed_compact_impl(state, rstate, inbox: TickInbox,
 paxos_tick_mixed_compact = jax.jit(
     _paxos_tick_mixed_compact_impl, donate_argnums=(0, 1),
     static_argnums=(3, 4, 5),
+)
+
+
+def _paxos_tick_mixed_compact_lease_impl(state, rstate, lease, rlease,
+                                         inbox: TickInbox, own_row: int,
+                                         exec_budget: int, lag_budget: int,
+                                         lease_horizon: int):
+    g_log = state.exec_slot.shape[1]
+    ib_l, ib_r = _split_inbox(inbox, g_log)
+    state, out_l, lease, lp_l = paxos_tick_impl(
+        state, ib_l, own_row, exec_budget, lease=lease,
+        lease_horizon=lease_horizon)
+    rstate, out_r, rlease, lp_r = paxos_tick_impl(
+        rstate, ib_r, own_row, exec_budget, lease=rlease,
+        lease_horizon=lease_horizon)
+    return (state, rstate, lease, rlease,
+            _compact_outbox_impl(out_l, exec_budget, lag_budget),
+            _compact_outbox_impl(out_r, exec_budget, lag_budget), lp_l, lp_r)
+
+
+paxos_tick_mixed_compact_lease = jax.jit(
+    _paxos_tick_mixed_compact_lease_impl, donate_argnums=(0, 1, 2, 3),
+    static_argnums=(5, 6, 7, 8),
 )
 
 

@@ -10,10 +10,12 @@ four shared mechanisms the rest of the tree wires in:
   ``overload_expired_drops_total{stage=...}`` counter instead of burning
   ticks on requests nobody is waiting for.
 - **Traffic classes** — ``CLS_CONTROL`` (failure detection,
-  reconfiguration RPCs, accepts/commits) vs ``CLS_CLIENT`` (proposes and
-  reads).  Transport keeps separate bounded send budgets per class and
-  drains control first, so a client flood can never starve liveness
-  traffic; the intake governor sheds only client-class work.
+  reconfiguration RPCs, accepts/commits), ``CLS_CLIENT`` (proposes/
+  writes), and ``CLS_READ`` (lease-era reads, ISSUE 17).  Transport
+  keeps separate bounded send budgets per class and drains control
+  first, so a client flood can never starve liveness traffic and a read
+  flood sheds independently of writes; the intake governor never sheds
+  control-class work.
 - **:class:`IntakeGovernor`** — watermark-with-hysteresis admission at
   the node intake, generalizing the PR-10 ``GPTPU_WAL_MIN_FREE_BYTES``
   disk shed: above the high watermark client proposes get an explicit
@@ -38,9 +40,13 @@ from .obs.metrics import registry
 # Traffic classes.  Integers on purpose: they index per-class queue/budget
 # arrays in the transport and stamp cheaply into stats keys.
 CLS_CONTROL = 0   # FD pings, reconfiguration RPCs, accepts/commits/ring
-CLS_CLIENT = 1    # client proposes, reads, and their responses
+CLS_CLIENT = 1    # client proposes (writes) and their responses
+CLS_READ = 2      # client reads (ISSUE 17): lease-local or consensus
+#                   fallback — their own transport budget, so a read flood
+#                   backpressures reads, never writes or control
 
-CLS_NAMES = {CLS_CONTROL: "control", CLS_CLIENT: "client"}
+CLS_NAMES = {CLS_CONTROL: "control", CLS_CLIENT: "client",
+             CLS_READ: "read"}
 
 # Pipeline stages that check deadlines, in flow order.  Used by tests and
 # dashboards; count_expired() accepts only these so a typo'd stage name

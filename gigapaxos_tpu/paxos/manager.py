@@ -49,12 +49,17 @@ _MGR_SEQ = _itertools.count()
 from . import state as st
 from .bulkstore import BulkOverrun, BulkStore
 from .paystore import PayloadStore
-from ..ops.tick import (CompactHostOutbox, HostOutbox, TickInbox,
-                        frontier_rows, merge_compact_outbox, merge_outbox,
+from ..ops.tick import (LP_ASN, LP_EPOCH, LP_HOLDER, LP_UNTIL, LP_WAIT,
+                        CompactHostOutbox, HostOutbox, TickInbox,
+                        frontier_rows, lease_clear_rows,
+                        merge_compact_outbox, merge_outbox,
                         paxos_tick_compact, paxos_tick_compact_demand,
-                        paxos_tick_mixed_compact, paxos_tick_mixed_packed,
-                        paxos_tick_packed, sweep_frontier, unpack_compact,
-                        unpack_outbox)
+                        paxos_tick_compact_lease, paxos_tick_mixed_compact,
+                        paxos_tick_mixed_compact_lease,
+                        paxos_tick_mixed_packed,
+                        paxos_tick_mixed_packed_lease, paxos_tick_packed,
+                        paxos_tick_packed_lease, sweep_frontier,
+                        unpack_compact, unpack_outbox)
 
 
 @dataclass
@@ -69,6 +74,16 @@ class RequestRecord:
     slot: int = -1  # filled at first execution
     executed_by: set = field(default_factory=set)
     responded: bool = False
+
+
+def _pad_rows(rows: np.ndarray, oob: int) -> np.ndarray:
+    """Pad a row batch to the next power of two with an out-of-range index
+    (``oob`` = plane size; jnp ``mode="drop"`` ignores it) so jitted
+    point-clears compile once per size class instead of once per batch."""
+    n = max(1, 1 << int(len(rows) - 1).bit_length())
+    out = np.full(n, oob, np.int32)
+    out[:len(rows)] = rows
+    return out
 
 
 class PaxosManager:
@@ -335,14 +350,48 @@ class PaxosManager:
 
                 self._demand_dev = _stk2.init_demand(self.mesh, self.G)
             elif self._use_compact and not self._device_app \
-                    and not self.G_reg:
+                    and not self.G_reg and not cfg.paxos.read_leases:
                 # single-device compact path: the intake-popcount fold runs
                 # fused inside paxos_tick_compact_demand (no mesh, so the
                 # GSPMD same-jit hazard doesn't apply) instead of the old
                 # O(G*P) host popcount per tick in _process_compact.
                 # Mixed planes keep the host fold: placement demand covers
                 # the LOG plane only (register rows never migrate shards).
+                # Lease builds keep the host fold too — the lease tick
+                # variants carry lease state instead of the demand array.
                 self._demand_dev = jnp.zeros(self.G, jnp.float32)
+        # ---- leader-lease plane (ISSUE 17) ----
+        # Dense [G]/[G_reg] lease columns folded inside the fused tick:
+        # holder/epoch/until live on device (authoritative for the write
+        # fence); the host keeps a per-tick [5, G_total] mirror
+        # (_lease_np) + its own lockstep clock for the local-read validity
+        # check.  None when off — lease-off builds run the literal
+        # pre-lease tick programs, bit for bit.
+        self._lease = None
+        self._rlease = None
+        self._lease_np = None         # [5, G_total] lease_pack mirror
+        self._lease_clock = 0         # host lockstep clock (+1/completed tick)
+        self._lease_skew_ticks = 0    # test hook: injected holder clock skew
+        self._lease_horizon = int(cfg.paxos.lease_ticks)
+        self._lease_margin = int(cfg.paxos.lease_margin_ticks)
+        if cfg.paxos.read_leases:
+            if self._device_app:
+                raise ValueError(
+                    "read_leases + device_app is not supported yet: the "
+                    "fused KV program has no lease formulation"
+                )
+            if cfg.paxos.mesh_devices:
+                raise ValueError(
+                    "read_leases + mesh_devices is not supported yet: the "
+                    "shard_map tick has no lease formulation"
+                )
+            from ..ops.tick import init_lease as _init_lease
+
+            self._lease = _init_lease(self.G, self._lease_margin)
+            self._rlease = (_init_lease(self.G_reg, self._lease_margin)
+                            if self.G_reg else None)
+            self._lease_np = np.zeros((5, self.G_total), np.int32)
+            self._lease_np[0, :] = -1  # holder column: -1 = none
         # first-occurrence scratch (generation-tagged so no per-tick clear)
         self._scr_pos = np.zeros(self.R * self.G_total, np.int64)
         self._scr_gen = np.zeros(self.R * self.G_total, np.int64)
@@ -390,6 +439,24 @@ class PaxosManager:
             "register_groups",
             help="register-mode (RMW) row capacity of this manager",
         ).set(self.G_reg)
+        # lease/read metric families (ISSUE 17; WIRING-gated)
+        self._lease_gauge = _obsreg().gauge(
+            "lease_holder_groups",
+            help="groups with a currently granted read lease",
+            node=self._ov_node)
+        self._reads_local_c = _obsreg().counter(
+            "reads_local_total",
+            help="reads answered locally under a valid lease (no consensus "
+                 "round)", node=self._ov_node)
+        self._reads_fallback_c = _obsreg().counter(
+            "reads_fallback_total",
+            help="reads that fell back to a consensus round (no/invalid "
+                 "lease or non-quiescent group)", node=self._ov_node)
+        self._lease_waits_c = _obsreg().counter(
+            "lease_waits_total",
+            help="per-tick count of groups whose coordinator is write-"
+                 "fenced waiting out a prior holder's lease",
+            node=self._ov_node)
         self.lock = ContendedLock()
         if self.wal is not None:
             self.wal.attach(self)
@@ -437,6 +504,122 @@ class PaxosManager:
             exec_slot=pst.exec_slot.at[r, prow].set(exec_slot),
             status=pst.status.at[r, prow].set(status),
         ))
+
+    # ------------------------------------------------------------ lease plane
+    # (ISSUE 17) Host side of the read-lease columns.  The device fold in
+    # ops/tick.py owns grant/renew/expiry and the write fence; the host
+    # mirrors each tick's [5, G] lease_pack and answers reads against it.
+
+    def _adopt_lease_pack(self, lease_pack) -> None:
+        """Consume one tick's lease pack(s) at completion (the device sync
+        point, so the pack describes the tick that just finished).  Mixed
+        planes hand a (log, register) pair that lands side by side in the
+        composite [5, G_total] mirror."""
+        if isinstance(lease_pack, tuple):
+            lp = np.concatenate([np.asarray(lease_pack[0]),
+                                 np.asarray(lease_pack[1])], axis=1)
+        else:
+            lp = np.asarray(lease_pack)
+        self._lease_np = lp
+        self._lease_clock += 1  # lockstep with the device fold's clock+1
+        self._lease_gauge.set(int((lp[LP_HOLDER] >= 0).sum()))
+        waits = int(lp[LP_WAIT].sum())
+        if waits:
+            self._lease_waits_c.inc(waits)
+
+    def _lease_drop_rows(self, rows) -> None:
+        """Reset lease columns for freed rows (remove/pause/migration): a
+        recycled row must not inherit the previous occupant's lease.  Row
+        batches are padded to the next power of two with an out-of-range
+        index (``mode="drop"`` ignores it) so the jitted clear compiles
+        once per size class, not once per batch."""
+        if self._lease is None or not len(rows):
+            return
+        if self._pending_out is not None:
+            # a pending tick's lease_pack predates this drop; complete it
+            # first so adoption cannot resurrect the dropped holder
+            self.drain_pipeline()
+        rows = np.asarray(rows, np.int32)
+        lrows = rows[rows < self.G]
+        rrows = rows[rows >= self.G] - np.int32(self.G)
+        if len(lrows):
+            self._lease = lease_clear_rows(
+                self._lease, _pad_rows(lrows, self.G))
+        if len(rrows) and self._rlease is not None:
+            self._rlease = lease_clear_rows(
+                self._rlease, _pad_rows(rrows, self.G_reg))
+        if self._lease_np is not None:
+            # the mirror may wrap a read-only device buffer zero-copy
+            self._lease_np = np.array(self._lease_np)
+            self._lease_np[LP_HOLDER, rows] = -1
+            self._lease_np[LP_UNTIL, rows] = 0
+
+    @_locked
+    def lease_info(self, name: str) -> Optional[dict]:
+        """Host view of one group's lease columns as of the last completed
+        tick (tests/observability; None when leases are off or the group
+        is not resident)."""
+        if self._lease_np is None:
+            return None
+        row = self.rows.row(name)
+        if row is None:
+            return None
+        lp = self._lease_np
+        return {
+            "holder": int(lp[LP_HOLDER, row]),
+            "epoch": int(lp[LP_EPOCH, row]),
+            "until": int(lp[LP_UNTIL, row]),
+            "asn": int(lp[LP_ASN, row]),
+            "clock": self._lease_clock,
+        }
+
+    def read(
+        self,
+        name: str,
+        payload: bytes = b"",
+        callback: Optional[Callable[[int, bytes], None]] = None,
+        deadline: Optional[int] = None,
+    ) -> Optional[int]:
+        """Linearizable read (ISSUE 17).
+
+        Answered LOCALLY — no consensus round, no journal entry — iff the
+        last completed tick's lease mirror shows a live holder whose lease
+        has not expired (minus any injected skew) AND the group is
+        quiescent: the holder's executed frontier equals the accepted
+        frontier as of that same tick, so every acked write is already
+        applied at the holder.  Otherwise the read falls back to a
+        CLS_READ propose through the ordered stream (a classic consensus
+        read), which also renews liveness for the next attempt.
+
+        ``payload`` must be side-effect-free under the app's ``execute``
+        (the same payload may execute once locally or R times via the
+        fallback).  The callback fires ``(rid, response)`` like propose's;
+        local reads use rid 0 and fire synchronously.
+        """
+        if deadline is not None and _overload.expired(deadline):
+            _overload.count_expired("intake", self._ov_node)
+            if callback is not None:
+                callback(_overload.RID_EXPIRED, None)
+            return None
+        row = self.rows.row(name)  # racy read: benign (propose's argument)
+        lp = self._lease_np
+        if (lp is not None and row is not None
+                and row not in self._stopped_rows):
+            holder = int(lp[LP_HOLDER, row])
+            if (holder >= 0 and self.alive[holder]
+                    and (self._lease_clock - self._lease_skew_ticks)
+                    < int(lp[LP_UNTIL, row])
+                    and int(self._host_exec[holder, row])
+                    == int(lp[LP_ASN, row])):
+                resp = self.apps[holder].execute(name, payload, 0)
+                self._reads_local_c.inc()
+                self.stats["local_reads"] += 1
+                if callback is not None:
+                    callback(0, resp)
+                return 0
+        self._reads_fallback_c.inc()
+        return self.propose(name, payload, callback, deadline=deadline,
+                            cls=_overload.CLS_READ)
 
     # ------------------------------------------------------------------ admin
     @_locked
@@ -614,6 +797,7 @@ class PaxosManager:
             row, st.free_groups(pst, np.array([prow], np.int32)))
         self._kv_clear_rows([row])
         self._clear_member_rows([row])
+        self._lease_drop_rows([row])
         self.rows.free(name)
         self._fail_queued(row)
         self._purge_row_outstanding(row)
@@ -823,6 +1007,7 @@ class PaxosManager:
         self.state = st.free_groups(self.state, np.array(rows_to_free, np.int32))
         self._kv_clear_rows(rows_to_free)
         self._clear_member_rows(rows_to_free)
+        self._lease_drop_rows(rows_to_free)
         for name in names:
             row = self.rows.free(name)
             self._stopped_rows.discard(row)
@@ -902,9 +1087,9 @@ class PaxosManager:
         """
         if self.wal is not None and not self.wal.accepting_writes():
             return self._shed_propose(callback)
-        if (cls == _overload.CLS_CLIENT and self.overload is not None
+        if (cls != _overload.CLS_CONTROL and self.overload is not None
                 and not self.overload.admit(cls)):
-            return self._shed_busy(callback)
+            return self._shed_busy(callback, cls)
         row = self.rows.row(name)  # racy read: benign (see docstring)
         if row is None:
             if name in self._paused:
@@ -938,15 +1123,16 @@ class PaxosManager:
         return None
 
     @_locked
-    def _shed_busy(self, callback):
+    def _shed_busy(self, callback, cls: int = _overload.CLS_CLIENT):
         """Intake governor shed (ISSUE 14): the explicit retriable NACK —
         the callback fires with RID_BUSY so the edge answers ``busy``
         (retry the SAME active after backoff) instead of a silent drop or
-        a misleading ``not_active``."""
+        a misleading ``not_active``.  ``cls`` labels the shed counter
+        (client writes vs lease-era consensus-fallback reads)."""
         if callback is not None:
             self._held_callbacks.append((callback, _overload.RID_BUSY, None))
         self.stats["shed_requests"] += 1
-        _overload.count_shed(_overload.CLS_CLIENT, "intake", self._ov_node)
+        _overload.count_shed(cls, "intake", self._ov_node)
         return None
 
     @_locked
@@ -1695,6 +1881,7 @@ class PaxosManager:
         pc.mark("intake")
         placed = self._placed
         bulk_placed = self._bulk_placed
+        lease_pack = None
         # dispatch first, journal second: the jitted step runs asynchronously
         # while the WAL appends+fsyncs this tick's record (SURVEY §2.2 item 3,
         # the BatchedLogger overlap, AbstractPaxosLogger.java:99-107).  Safe
@@ -1722,7 +1909,27 @@ class PaxosManager:
         elif self._mesh_tick is not None:
             self.state, packed = self._mesh_tick(self.state, inbox)
         elif self._use_compact:
-            if self.rstate is not None:
+            if self._lease is not None and self.rstate is not None:
+                # lease twin of the mixed compact tick: both planes fold
+                # their own lease columns; the [5, G] lease packs ride the
+                # pending tuple and are pulled at completion
+                (self.state, self.rstate, self._lease, self._rlease,
+                 flat_l, flat_r, lp_l, lp_r) = paxos_tick_mixed_compact_lease(
+                    self.state, self.rstate, self._lease, self._rlease,
+                    inbox, -1, self._exec_budget, self._lag_budget,
+                    self._lease_horizon,
+                )
+                packed = (flat_l, flat_r)
+                lease_pack = (lp_l, lp_r)
+            elif self._lease is not None:
+                self.state, self._lease, packed, lease_pack = (
+                    paxos_tick_compact_lease(
+                        self.state, self._lease, inbox, -1,
+                        self._exec_budget, self._lag_budget,
+                        self._lease_horizon,
+                    )
+                )
+            elif self.rstate is not None:
                 # mixed planes: one fused program splits the composite
                 # inbox at g_log, ticks both planes with their native W
                 # (log ring vs register), and compacts each — merged back
@@ -1750,6 +1957,17 @@ class PaxosManager:
                 self.state, packed = paxos_tick_compact(
                     self.state, inbox, -1, self._exec_budget, self._lag_budget
                 )
+        elif self._lease is not None and self.rstate is not None:
+            (self.state, self.rstate, self._lease, self._rlease,
+             pk_l, pk_r, lp_l, lp_r) = paxos_tick_mixed_packed_lease(
+                self.state, self.rstate, self._lease, self._rlease,
+                inbox, -1, 0, self._lease_horizon)
+            packed = (pk_l, pk_r)
+            lease_pack = (lp_l, lp_r)
+        elif self._lease is not None:
+            self.state, self._lease, packed, lease_pack = (
+                paxos_tick_packed_lease(self.state, self._lease, inbox, -1,
+                                        0, self._lease_horizon))
         elif self.rstate is not None:
             self.state, self.rstate, pk_l, pk_r = paxos_tick_mixed_packed(
                 self.state, self.rstate, inbox, -1, 0)
@@ -1814,20 +2032,22 @@ class PaxosManager:
                 # of dropping it, so callers polling tick() never miss a
                 # completed outbox on sync-due ticks
                 out, self._drained_out = self._drained_out, None
-            self._pending_out = (packed, placed, bulk_placed, frontier)
+            self._pending_out = (packed, placed, bulk_placed, frontier,
+                                 lease_pack)
             # a due checkpoint must cover on-host effects of every tick the
             # device state contains — drain the one-tick pipeline first
             if self.wal is not None and self.wal.checkpoint_due():
                 self.drain_pipeline()
         else:
-            out = self._complete_tick(packed, placed, bulk_placed, frontier)
+            out = self._complete_tick(packed, placed, bulk_placed, frontier,
+                                      lease_pack)
         if self.wal is not None:
             self.wal.maybe_checkpoint()
         pc.end()
         return out
 
     def _complete_tick(self, packed, placed: list, bulk_placed=None,
-                       frontier=None):
+                       frontier=None, lease_pack=None):
         """Consume one tick's outbox (unpacking = the device sync point):
         requeue rejected intake, execute the ordered decision stream,
         release durable callbacks, periodic GC."""
@@ -1835,6 +2055,8 @@ class PaxosManager:
         # re-arm without observing: drain_pipeline completes a deferred tick
         # outside tick(), and cross-call idle time must not land in "tally"
         pc.touch()
+        if lease_pack is not None:
+            self._adopt_lease_pack(lease_pack)
         if self._use_compact:
             if isinstance(packed, tuple):
                 # mixed planes: two per-plane compact buffers; unpack each

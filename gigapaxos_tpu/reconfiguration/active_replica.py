@@ -217,6 +217,7 @@ class ActiveReplica:
         self._xt = _xtracer()
         for ptype, h in [
             (pkt.APP_REQUEST, self._on_app_request),
+            (pkt.APP_READ, self._on_app_read),
             (pkt.APP_REQUEST_BATCH, self._on_app_request_batch),
             (pkt.ACTIVES_RESPONSE, self._on_actives_response),
             (pkt.STOP_EPOCH, self._on_stop_epoch),
@@ -399,6 +400,62 @@ class ActiveReplica:
                 with self._dedup_lock:
                     self._req_dedup.pop(key, None)
                     self._dedup_born.pop(key, None)
+
+    def _on_app_read(self, sender: str, p: dict) -> None:
+        """Lease-era read entry (ISSUE 17).  Reads are side-effect-free by
+        contract, so retransmissions are harmless — no dedup-map traffic:
+        a retried rid simply reads again.  Responses reuse APP_RESPONSE
+        (same client callback path) but travel CLS_READ, so a read flood
+        backpressures reads without touching writes or control."""
+        pkt.register_client(self.m.nodemap, p)
+        name, rid = p["name"], p["rid"]
+        if _ov.expired(p.get("deadline")):
+            _ov.count_expired("ar_ingress", self.node_id)
+            return
+        reply_to = p.get("reply_to") or sender
+
+        def refuse(err: str) -> None:
+            self.m.send(reply_to, {
+                "type": pkt.APP_RESPONSE, "rid": rid, "ok": False,
+                "error": err, "name": name}, cls=_ov.CLS_READ)
+
+        epoch = self.coord.current_epoch(name)
+        if epoch is None:
+            refuse("not_active")
+            return
+        gov = getattr(self.coord, "intake_governor", None)
+        if gov is not None and not gov.admit(_ov.CLS_READ):
+            _ov.count_shed(_ov.CLS_READ, "ar_ingress", self.node_id)
+            refuse("busy")
+            return
+        dl = p.get("deadline")
+        dl = dl if isinstance(dl, int) and dl > 0 else None
+
+        def cb(req_id: int, resp: Optional[bytes]) -> None:
+            if req_id == _ov.RID_EXPIRED:
+                return  # counted by the detecting stage; never respond
+            if req_id < 0 or resp is None:
+                refuse("busy" if req_id == _ov.RID_BUSY else "stopped")
+                return
+            if _ov.expired(dl):
+                _ov.count_expired("egress", self.node_id)
+                return
+            self.m.send(reply_to, {
+                "type": pkt.APP_RESPONSE, "rid": rid, "ok": True,
+                "name": name, "response": pkt.b64e(resp),
+                "local": req_id == 0}, cls=_ov.CLS_READ)
+
+        payload = pkt.b64d(p["payload"]) or b""
+        read = getattr(self.coord, "coordinate_read", None)
+        if read is not None:
+            r = read(name, epoch, payload, cb, deadline=dl)
+        else:
+            # coordinator without a lease plane (chain/Mode-B shims):
+            # plain consensus read through the ordered stream
+            r = self.coord.coordinate_request(
+                name, epoch, payload, cb, entry=self.node_id, deadline=dl)
+        if r is None:
+            refuse("not_active")
 
     def _on_app_request_batch(self, sender: str, p: dict) -> None:
         """Coalesced client edge: one frame of requests in, one frame of

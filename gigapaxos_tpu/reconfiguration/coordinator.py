@@ -179,6 +179,24 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
             deadline=deadline, cls=_ov.CLS_CLIENT,
         )
 
+    def coordinate_read(
+        self,
+        name: str,
+        epoch: int,
+        payload: bytes,
+        callback: Optional[Callable[[int, Optional[bytes]], None]] = None,
+        deadline: Optional[int] = None,
+    ) -> Optional[int]:
+        """Read-path twin of :meth:`coordinate_request` (ISSUE 17):
+        answered from the lease holder's local state when the lease mirror
+        validates, else the manager falls back to a CLS_READ consensus
+        round through the ordered stream."""
+        if self._epoch.get(name) != epoch:
+            return None  # wrong/old epoch: client must re-resolve actives
+        return self.manager.read(
+            self._pax_name(name, epoch), payload, callback, deadline=deadline,
+        )
+
     @property
     def intake_governor(self):
         """The manager's overload governor (None when disabled) — the edge

@@ -56,6 +56,10 @@ NODE_CONFIG_RESPONSE = "node_config_response"
 # client <-> active replica
 APP_REQUEST = "app_request"                        # AppRequest / ReplicableClientRequest
 APP_RESPONSE = "app_response"
+# lease-era linearizable read (ISSUE 17): answered locally by a valid
+# lease holder, else through a consensus round; the payload must be
+# side-effect-free under the app.  Responses reuse APP_RESPONSE.
+APP_READ = "app_read"
 # many client requests in one frame + one frame of responses back — the
 # client-edge RequestBatcher (RequestPacket.java:189-233 `batched[]`,
 # RequestBatcher.java:25-60).  Dedup is batch-granular: retransmissions
@@ -146,6 +150,15 @@ def app_request(
         "payload": b64e(payload),
         "rid": rid,
         "need_response": need_response,
+    }
+
+
+def app_read(name: str, payload: bytes, rid: int) -> dict:
+    return {
+        "type": APP_READ,
+        "name": name,
+        "payload": b64e(payload),
+        "rid": rid,
     }
 
 

@@ -35,8 +35,21 @@ TX_MAGIC = b"\x01TX\x01"
 TX_LOCKED = b"\x01TX_LOCKED"
 
 
-def tx_payload(op: str, txid: str, inner: Optional[bytes] = None) -> bytes:
-    head = TX_MAGIC + json.dumps({"op": op, "txid": txid}).encode()
+def tx_payload(op: str, txid: str, inner: Optional[bytes] = None,
+               now: Optional[int] = None,
+               deadline: Optional[int] = None) -> bytes:
+    """``now``/``deadline`` are PR-14 wire deadlines (unix ms,
+    overload.deadline_at): ``deadline`` on a lock op bounds how long the
+    acquired lock may be held; ``now`` is the sender's clock stamp that
+    lets participants expire stale locks deterministically (the stamp is
+    part of the ordered payload bytes, so every replica sees the same
+    value at the same slot — no local clock reads in the decision)."""
+    meta: Dict[str, object] = {"op": op, "txid": txid}
+    if now is not None:
+        meta["now"] = int(now)
+    if deadline is not None:
+        meta["deadline"] = int(deadline)
+    head = TX_MAGIC + json.dumps(meta).encode()
     return head + b"\x00" + inner if inner is not None else head
 
 
@@ -53,11 +66,20 @@ class TxApp(Replicable):
     * ``exec``   — run the inner request iff the lock is held by txid;
     * any non-transactional request on a locked name is refused with
       ``TX_LOCKED`` — the client retries after the transaction commits.
+
+    Stale-lock expiry (ISSUE 17): a coordinator crashing between lock and
+    commit would leave the lock held forever.  Lock ops may carry a
+    ``deadline`` (unix ms) bounding the hold; any later *conflicting* tx
+    op stamped with the sender's ``now`` auto-releases a lock whose
+    deadline has passed before the normal logic runs.  Both stamps ride
+    the ordered payload, so expiry is a pure function of the request
+    stream — identical on every replica and under WAL replay.
     """
 
     def __init__(self, app: Replicable):
         self.app = app
         self.locks: Dict[str, str] = {}  # name -> holder txid
+        self.lock_deadlines: Dict[str, int] = {}  # name -> unix-ms bound
 
     def execute(self, name: str, request: bytes, request_id: int) -> bytes:
         if not request.startswith(TX_MAGIC):
@@ -70,14 +92,31 @@ class TxApp(Replicable):
         inner = None if sep < 0 else body[sep + 1:]
         op, txid = meta["op"], meta["txid"]
         holder = self.locks.get(name)
+        # deterministic stale-lock expiry: a conflicting op whose ordered
+        # now-stamp exceeds the holder's deadline releases the lock (the
+        # holder's own ops never expire it — idempotent re-acquire and a
+        # late commit by a live-but-slow coordinator both stay legal; its
+        # exec after a rival expired the lock gets TX_LOCKED and aborts)
+        if holder is not None and holder != txid:
+            dl = self.lock_deadlines.get(name, 0)
+            if 0 < dl < int(meta.get("now") or 0):
+                del self.locks[name]
+                self.lock_deadlines.pop(name, None)
+                holder = None
         if op == "lock":
             if holder is None or holder == txid:
                 self.locks[name] = txid
+                dl = int(meta.get("deadline") or 0)
+                if dl > 0:
+                    self.lock_deadlines[name] = dl
+                else:
+                    self.lock_deadlines.pop(name, None)
                 return b"TX_OK"
             return TX_LOCKED
         if op == "unlock":
             if holder == txid:
                 del self.locks[name]
+                self.lock_deadlines.pop(name, None)
             return b"TX_OK"
         if op == "exec":
             if holder != txid:
@@ -90,7 +129,11 @@ class TxApp(Replicable):
         # with TX_MAGIC would be misparsed as a lock header on restore
         inner = self.app.checkpoint(name)
         holder = self.locks.get(name)
-        return TX_MAGIC + json.dumps({"holder": holder}).encode() + b"\x00" + inner
+        meta = {"holder": holder}
+        dl = self.lock_deadlines.get(name)
+        if holder is not None and dl:
+            meta["deadline"] = dl
+        return TX_MAGIC + json.dumps(meta).encode() + b"\x00" + inner
 
     def restore(self, name: str, state: bytes) -> None:
         if state.startswith(TX_MAGIC):
@@ -103,12 +146,19 @@ class TxApp(Replicable):
             if meta is not None:
                 if meta.get("holder") is None:
                     self.locks.pop(name, None)
+                    self.lock_deadlines.pop(name, None)
                 else:
                     self.locks[name] = meta["holder"]
+                    dl = int(meta.get("deadline") or 0)
+                    if dl > 0:
+                        self.lock_deadlines[name] = dl
+                    else:
+                        self.lock_deadlines.pop(name, None)
                 self.app.restore(name, body[sep + 1:])
                 return
         # plain state (client-provided initial state / legacy checkpoint)
         self.locks.pop(name, None)
+        self.lock_deadlines.pop(name, None)
         self.app.restore(name, state)
 
 
@@ -157,10 +207,17 @@ class DistTransactor:
         coordinate: Callable[[str, bytes, Callable[[Optional[bytes]], None]], object],
         max_lock_retries: int = 20,
         retry_delay_s: float = 0.05,
+        lock_ttl_s: Optional[float] = None,
     ):
+        """``lock_ttl_s``: bound every acquired lock's hold time (PR-14
+        wire-deadline unit under the hood).  A transactor that crashes
+        between lock and commit then no longer wedges the participants —
+        the next conflicting transaction's stamped op expires the stale
+        lock.  None (default) keeps the original hold-forever semantics."""
         self.coordinate = coordinate
         self.max_lock_retries = max_lock_retries
         self.retry_delay_s = retry_delay_s
+        self.lock_ttl_s = lock_ttl_s
 
     # ------------------------------------------------------------------ public
     def transact(
@@ -212,6 +269,12 @@ class DistTransactor:
     def _run(self, ops, res: TxResult, callback) -> None:
         import time
 
+        def now_ms() -> Optional[int]:
+            # stamp ops only when expiry is enabled: unstamped payloads
+            # keep the original bytes, so existing journals/tests are
+            # byte-identical when lock_ttl_s is None
+            return int(time.time() * 1000) if self.lock_ttl_s else None
+
         names = sorted({n for n, _ in ops})  # global order = deadlock freedom
         held: List[str] = []
         try:
@@ -224,7 +287,11 @@ class DistTransactor:
                 held.append(n)
                 acquired = False
                 for attempt in range(self.max_lock_retries):
-                    r = self._call(n, tx_payload("lock", res.txid))
+                    dl = (None if self.lock_ttl_s is None
+                          else int(time.time() * 1000
+                                   + self.lock_ttl_s * 1000))
+                    r = self._call(n, tx_payload("lock", res.txid,
+                                                 now=now_ms(), deadline=dl))
                     if r == b"TX_OK":
                         acquired = True
                         break
@@ -237,9 +304,11 @@ class DistTransactor:
                     return
             # ---- phase 2 (commit): execute under locks
             for n, payload in ops:
-                r = self._call(n, tx_payload("exec", res.txid, payload))
+                r = self._call(n, tx_payload("exec", res.txid, payload,
+                                             now=now_ms()))
                 if r is None or r == TX_LOCKED:
-                    # lock lost (epoch change mid-tx): abort — executed ops on
+                    # lock lost (epoch change mid-tx or our lease expired
+                    # under a rival's stamp): abort — executed ops on
                     # other names are NOT rolled back, matching the
                     # experimental reference's semantics; see module doc
                     res.aborted = True
@@ -248,8 +317,11 @@ class DistTransactor:
                 res.results.append(r)
             res.committed = True
         finally:
+            # release on abort covers the expired-txid case too: unlock is
+            # holder-checked, so releasing a lock a rival already expired
+            # and re-acquired is a no-op rather than a theft
             for n in held:
-                self._call(n, tx_payload("unlock", res.txid))
+                self._call(n, tx_payload("unlock", res.txid, now=now_ms()))
             res._finish()
             if callback is not None:
                 callback(res)

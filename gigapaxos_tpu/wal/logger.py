@@ -640,6 +640,18 @@ class PaxosLogger:
             # device-app state snapshots alongside the consensus arrays
             for f in m.kv._fields:
                 state_np["dkv_" + f] = np.asarray(getattr(m.kv, f))
+        if getattr(m, "_lease", None) is not None:
+            # lease plane (ISSUE 17): O(G) columns + the lockstep clock
+            # under a lease_/rlease_ prefix; journal replay re-evolves
+            # them tick for tick, so the snapshot is their only root
+            for f in m._lease._fields:
+                state_np["lease_" + f] = np.asarray(getattr(m._lease, f))
+            if getattr(m, "_rlease", None) is not None:
+                for f in m._rlease._fields:
+                    state_np["rlease_" + f] = np.asarray(
+                        getattr(m._rlease, f))
+            if getattr(m, "_lease_np", None) is not None:
+                state_np["lease_pack"] = np.asarray(m._lease_np)
         meta = self._meta(m)
         # Reset the dedup epoch with the journal roll: each journal is
         # self-contained (every payref resolves to a raw body earlier in
@@ -1000,6 +1012,25 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
         # snapshot's device watermark IS the host-applied one; leaving
         # _host_exec at zero would disable the sweep's passed-branch until
         # every member executes again post-recovery
+        if m._lease is not None and any(
+                k.startswith("lease_") for k in arrs.files):
+            # lease plane (ISSUE 17): restore both planes' lease columns,
+            # the host mirror, and the lockstep clock (== the device
+            # clock; both advance once per completed tick)
+            from ..ops.tick import LeaseState
+
+            m._lease = LeaseState(**{
+                f: jnp.asarray(arrs["lease_" + f])
+                for f in LeaseState._fields
+            })
+            if m._rlease is not None and "rlease_holder" in arrs.files:
+                m._rlease = LeaseState(**{
+                    f: jnp.asarray(arrs["rlease_" + f])
+                    for f in LeaseState._fields
+                })
+            if "lease_pack" in arrs.files:
+                m._lease_np = np.asarray(arrs["lease_pack"]).copy()
+            m._lease_clock = int(np.asarray(arrs["lease_clock"]))
         if m.rstate is not None:
             m._host_exec = m._dev_exec_np().astype(np.int32)
             m._member_np = np.hstack([np.asarray(m.state.member),
@@ -1123,8 +1154,30 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
         def tick_host(state, inbox):
             # replay must evolve state EXACTLY as the live run did, so the
             # exec budget (if the live run used the compact path) applies
-            # here too even though replay consumes the full outbox
+            # here too even though replay consumes the full outbox — and a
+            # lease-era run replays through the lease tick variants, whose
+            # fold is a pure function of (state, inbox), so the lease
+            # columns re-evolve tick for tick
             budget = m._exec_budget if m._use_compact else 0
+            if m._lease is not None and m.rstate is not None:
+                from ..ops.tick import (merge_outbox,
+                                        paxos_tick_mixed_packed_lease)
+
+                (state, m.rstate, m._lease, m._rlease, pk_l, pk_r,
+                 lp_l, lp_r) = paxos_tick_mixed_packed_lease(
+                    state, m.rstate, m._lease, m._rlease, inbox, -1,
+                    budget, m._lease_horizon)
+                m._adopt_lease_pack((lp_l, lp_r))
+                out_l = unpack_outbox(pk_l, m.R, m.P, m.W, m.G)
+                out_r = unpack_outbox(pk_r, m.R, m.P, 1, m.G_reg)
+                return state, merge_outbox(out_l, out_r)
+            if m._lease is not None:
+                from ..ops.tick import paxos_tick_packed_lease
+
+                state, m._lease, packed, lp = paxos_tick_packed_lease(
+                    state, m._lease, inbox, -1, budget, m._lease_horizon)
+                m._adopt_lease_pack(lp)
+                return state, unpack_outbox(packed, m.R, m.P, m.W, m.G)
             if m.rstate is not None:
                 from ..ops.tick import (merge_outbox,
                                         paxos_tick_mixed_packed)

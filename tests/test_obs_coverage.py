@@ -112,6 +112,13 @@ WIRING = {
     "paystore_misses_total": "gigapaxos_tpu/paxos/paystore.py",
     "paystore_evictions_total": "gigapaxos_tpu/paxos/paystore.py",
     "register_groups": "gigapaxos_tpu/paxos/manager.py",
+    # lease plane (ISSUE 17): local-read economics — holder gauge, the
+    # local/fallback split, and writes parked behind a prior holder's lease
+    "lease_holder_groups": "gigapaxos_tpu/paxos/manager.py",
+    "reads_local_total": "gigapaxos_tpu/paxos/manager.py",
+    "reads_fallback_total": "gigapaxos_tpu/paxos/manager.py",
+    "lease_waits_total": "gigapaxos_tpu/paxos/manager.py",
+    "client_read_latency_seconds": "gigapaxos_tpu/client.py",
     "client_commit_latency_seconds": "gigapaxos_tpu/client.py",
     "client_batch_rtt_seconds": "gigapaxos_tpu/client.py",
     "commit_latency_seconds":

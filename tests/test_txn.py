@@ -1,7 +1,8 @@
 """Transaction layer tests (src/edu/umass/cs/txn analog, SURVEY §2.5).
 
 Atomicity across names, lock conflict serialization, deadlock freedom via
-global lock order, and lock blocking of plain requests.
+global lock order, lock blocking of plain requests, and deterministic
+stale-lock expiry (ISSUE 17) exercised through a 2-name counter app.
 """
 
 import threading
@@ -9,10 +10,51 @@ import threading
 import pytest
 
 from gigapaxos_tpu.config import GigapaxosTpuConfig
-from gigapaxos_tpu.models.replicable import KVApp
+from gigapaxos_tpu.models.replicable import KVApp, Replicable
 from gigapaxos_tpu.paxos.manager import PaxosManager
 from gigapaxos_tpu.paxos.driver import TickDriver
-from gigapaxos_tpu.txn import DistTransactor, TxApp, TX_LOCKED
+from gigapaxos_tpu.txn import DistTransactor, TxApp, TX_LOCKED, tx_payload
+
+
+class CounterApp(Replicable):
+    """Minimal counter state machine: ``ADD <delta>`` / ``GET`` per name."""
+
+    def __init__(self):
+        self.vals = {}
+
+    def execute(self, name: str, request: bytes, request_id: int) -> bytes:
+        parts = request.decode().split()
+        if parts and parts[0] == "ADD":
+            self.vals[name] = self.vals.get(name, 0) + int(parts[1])
+            return str(self.vals[name]).encode()
+        if parts and parts[0] == "GET":
+            return str(self.vals.get(name, 0)).encode()
+        return b"ERR"
+
+    def checkpoint(self, name: str) -> bytes:
+        return str(self.vals.get(name, 0)).encode()
+
+    def restore(self, name: str, state: bytes) -> None:
+        self.vals[name] = int(state) if state else 0
+
+
+@pytest.fixture()
+def counter_plane():
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 16
+    mgr = PaxosManager(cfg, 3, [TxApp(CounterApp()) for _ in range(3)])
+    for name in ("aaa", "bbb"):
+        mgr.create_paxos_instance(name, [0, 1, 2])
+    driver = TickDriver(mgr).start()
+    driver.wait_ready()
+
+    def coordinate(name, payload, cb):
+        r = mgr.propose(name, payload, cb)
+        driver.kick()
+        return r
+
+    yield mgr, coordinate
+    driver.stop()
 
 
 @pytest.fixture()
@@ -124,3 +166,112 @@ def test_txapp_checkpoint_carries_lock(plane):
     assert blob2.startswith(b"\x01TX\x01")
     fresh.restore("n", blob2)
     assert "n" not in fresh.locks and fresh.app.db["n"]["k"] == "v"
+
+
+# ------------------------------------------------- 2-name counter app (ISSUE 17)
+
+def test_counter_commit_and_sorted_lock_order(counter_plane):
+    mgr, coordinate = counter_plane
+    import json
+
+    from gigapaxos_tpu.txn.transactor import TX_MAGIC
+
+    lock_order = []
+
+    def spying(name, payload, cb):
+        if payload.startswith(TX_MAGIC):
+            body = payload[len(TX_MAGIC):]
+            sep = body.find(b"\x00")
+            meta = json.loads((body if sep < 0 else body[:sep]).decode())
+            if meta["op"] == "lock":
+                lock_order.append(name)
+        return coordinate(name, payload, cb)
+
+    tx = DistTransactor(spying)
+    # ops deliberately listed in REVERSE name order — the transactor must
+    # still acquire in global sorted order (deadlock freedom)
+    res = tx.transact([("bbb", b"ADD 10"), ("aaa", b"ADD -10")]).wait()
+    assert res.committed and not res.aborted
+    assert lock_order == ["aaa", "bbb"]
+    for app in mgr.apps:
+        assert app.app.vals["aaa"] == -10
+        assert app.app.vals["bbb"] == 10
+        assert app.locks == {}
+
+
+def test_counter_abort_on_locked(counter_plane):
+    mgr, coordinate = counter_plane
+    ev = threading.Event()
+    coordinate("bbb", tx_payload("lock", "rivaltx"), lambda rid, r: ev.set())
+    assert ev.wait(20)
+    tx = DistTransactor(coordinate, max_lock_retries=2, retry_delay_s=0.01)
+    res = tx.transact([("aaa", b"ADD 5"), ("bbb", b"ADD -5")]).wait()
+    assert res.aborted and not res.committed
+    # nothing executed, and the aaa lock taken during prepare was released;
+    # the rival's (deadline-free) lock is untouched
+    assert mgr.apps[0].app.vals.get("aaa", 0) == 0
+    assert mgr.apps[0].locks == {"bbb": "rivaltx"}
+
+
+def test_crash_during_commit_releases_stale_locks(counter_plane):
+    """A coordinator crashing between lock and commit must not wedge the
+    participants: the next transaction's stamped ops expire the stale
+    locks (deterministically — the stamps ride the ordered stream)."""
+    import time as _time
+
+    mgr, coordinate = counter_plane
+    dead_dl = int(_time.time() * 1000) - 1  # hold bound already passed
+    for n in ("aaa", "bbb"):
+        ev, got = threading.Event(), {}
+        coordinate(n, tx_payload("lock", "deadtx", now=dead_dl - 10,
+                                 deadline=dead_dl),
+                   lambda rid, r: (got.update(r=r), ev.set()))
+        assert ev.wait(20) and got["r"] == b"TX_OK"
+    # "crash" here: no exec, no unlock.  Plain requests carry no stamp and
+    # cannot expire the lock — still refused...
+    ev, got = threading.Event(), {}
+    coordinate("aaa", b"ADD 1", lambda rid, r: (got.update(r=r), ev.set()))
+    assert ev.wait(20) and got["r"] == TX_LOCKED
+    # ...but a TTL-stamping transactor expires + reacquires and commits
+    tx = DistTransactor(coordinate, lock_ttl_s=30.0)
+    res = tx.transact([("aaa", b"ADD 7"), ("bbb", b"ADD -7")]).wait()
+    assert res.committed and not res.aborted
+    for app in mgr.apps:
+        assert app.app.vals["aaa"] == 7 and app.app.vals["bbb"] == -7
+        assert app.locks == {} and app.lock_deadlines == {}
+
+
+def test_expired_holder_exec_refused_and_replay_deterministic():
+    """Expiry is a pure function of the ordered bytes: replaying the same
+    stream yields the same lock table and responses, and the expired
+    holder's late exec is refused (it aborts instead of double-applying)."""
+    stream = [
+        tx_payload("lock", "t1", now=1000, deadline=2000),
+        tx_payload("lock", "t2", now=3000, deadline=9000),  # expires t1
+        tx_payload("exec", "t1", b"ADD 1", now=3500),  # late commit: refused
+        tx_payload("unlock", "t1", now=3600),  # abort release: holder-checked
+        tx_payload("exec", "t2", b"ADD 5", now=4000),
+        tx_payload("unlock", "t2", now=4100),
+    ]
+    outs = []
+    for _ in range(2):
+        app = TxApp(CounterApp())
+        outs.append([app.execute("n", p, i) for i, p in enumerate(stream)])
+        assert app.locks == {} and app.lock_deadlines == {}
+        assert app.app.vals["n"] == 5
+    assert outs[0] == outs[1]
+    assert outs[0][1] == b"TX_OK"  # t2 acquired over the expired t1
+    assert outs[0][2] == TX_LOCKED
+
+
+def test_checkpoint_carries_lock_deadline():
+    app = TxApp(CounterApp())
+    assert app.execute(
+        "n", tx_payload("lock", "t1", now=10, deadline=500), 1) == b"TX_OK"
+    fresh = TxApp(CounterApp())
+    fresh.restore("n", app.checkpoint("n"))
+    assert fresh.locks["n"] == "t1" and fresh.lock_deadlines["n"] == 500
+    # a stamped rival past the bound expires it on the restored replica too
+    assert fresh.execute(
+        "n", tx_payload("lock", "t2", now=501, deadline=900), 2) == b"TX_OK"
+    assert fresh.locks["n"] == "t2"

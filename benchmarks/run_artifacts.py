@@ -417,6 +417,19 @@ def bench_reads() -> dict:
     }
 
 
+def bench_health() -> dict:
+    """Group-health plane gate (benchmarks/health_bench.py): refreshes
+    results_health_pr18.json — decisions/s at the capacity knee and 1M-
+    group tick ms with the in-tick health fold on vs off (plus an
+    on+GPTPU_METRICS=0 arm isolating the device fold), must stay
+    under 2%."""
+    r = _script(["benchmarks/health_bench.py"], timeout=3600)[-1]
+    if not r["pass"]:
+        raise RuntimeError(
+            f"health fold overhead {r['value']}% >= {r['pass_lt_pct']}% gate")
+    return r
+
+
 def bench_cells_capacity() -> dict:
     """Serving-cells capacity sweep (benchmarks/cells_capacity.py):
     refreshes results_capacity_cells_pr8.json (1 -> 2 -> 4 cells with
@@ -506,6 +519,8 @@ def main() -> None:
     run("register", bench_register)
     # lease plane (PR 17): linearizable local reads — 95/5 speedup gate
     run("reads", bench_reads)
+    # health plane (PR 18): in-tick group-health fold overhead gate
+    run("health", bench_health)
 
     out = args.out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),

@@ -73,6 +73,10 @@ def main() -> None:
     ap.add_argument("--lat-samples", type=int, default=64,
                     help="commit-latency samples per measured tick "
                          "(0 disables)")
+    ap.add_argument("--health", action="store_true",
+                    help="fold the group-health plane into the tick "
+                         "(paxos.group_health; ISSUE 18 A/B arm)")
+    ap.add_argument("--health-topk", type=int, default=8)
     args = ap.parse_args()
 
     import jax
@@ -103,6 +107,9 @@ def main() -> None:
     cfg.paxos.deactivation_ticks = 0  # no pause scans mid-measurement
     if args.device:
         cfg.paxos.device_app = True
+    if args.health:
+        cfg.paxos.group_health = True
+        cfg.paxos.health_topk = args.health_topk
     if args.baseline == "unreplicated":
         cfg.paxos.emulate_unreplicated = True
     elif args.baseline == "lazy":
@@ -230,7 +237,7 @@ def main() -> None:
         "unit": "decisions/s",
         "vs_baseline": round(decisions / dt / 100_000.0, 2),
         "detail": {
-            "ticks_per_s": round(args.ticks / dt, 2),
+            "ticks_per_s": round(args.ticks / dt, 4),
             "completions_per_s": round(done / dt, 1),
             # unreplicated executes at the entry replica ONLY (no
             # coordination); every other mode executes on all R replicas

@@ -155,6 +155,18 @@ class CellHandle:
     def stats(self, timeout: float = 30.0) -> dict:
         return json.loads(self.rpc("stats", "stats ", timeout)[6:])
 
+    def healthz(self, timeout: float = 30.0) -> dict:
+        return json.loads(self.rpc("healthz", "healthz ", timeout)[8:])
+
+    def health(self, timeout: float = 30.0):
+        return json.loads(self.rpc("health", "health ", timeout)[7:])
+
+    def group(self, name: str, timeout: float = 30.0):
+        return json.loads(self.rpc(f"group {name}", "group ", timeout)[6:])
+
+    def timeline(self, timeout: float = 30.0) -> dict:
+        return json.loads(self.rpc("timeline", "timeline ", timeout)[9:])
+
     def metrics(self, timeout: float = 30.0) -> str:
         """This cell's Prometheus text body (every series cell-labelled)."""
         return json.loads(self.rpc("metrics", "metrics ", timeout)[8:])
@@ -302,6 +314,13 @@ class CellSupervisor:
         self.metrics_server: Optional[MetricsServer] = None
         self._http_port = http_port
         self._trace_wire = trace_wire
+        # supervisor-side timeline: events only (cell deaths, respawns, fd
+        # verdicts) — unstarted sampler thread; the per-cell series come
+        # from each worker's own recorder and merge in timeline()
+        from ..obs.timeline import TimelineRecorder
+
+        self.timeline_rec = TimelineRecorder(
+            lambda: {}, node=SUP_ID)
 
     # ---------------------------------------------------------------- spawn
     def start(self) -> "CellSupervisor":
@@ -316,6 +335,8 @@ class CellSupervisor:
         if self._http_port is not None and self._http_port >= 0:
             self.metrics_server = MetricsServer(
                 self.scrape, trace=self._trace_route,
+                healthz=self.healthz, health=self.health,
+                group=self.group_info, timeline=self.timeline,
                 port=self._http_port)
         return self
 
@@ -324,6 +345,7 @@ class CellSupervisor:
         # live-but-wedged cell surfaces here for operators/tests; actual
         # respawn keys off process death (deterministic under chaos)
         self.fd_events.append((time.monotonic(), node, up))
+        self.timeline_rec.annotate("fd_change", target=node, up=up)
         if not up:
             self._g_fd_down.inc()
 
@@ -338,6 +360,8 @@ class CellSupervisor:
                     continue  # crash-looping cell: leave it down
                 self.restarts[k] += 1
                 self._g_restarts[k].set(self.restarts[k])
+                self.timeline_rec.annotate("cell_death", cell=k,
+                                           restarts=self.restarts[k])
                 time.sleep(backoff)
                 if self._stopping:
                     return
@@ -345,6 +369,7 @@ class CellSupervisor:
                     nh = CellHandle(self.specs[k], python=self.python)
                     nh.expect("ready", timeout=self.ready_timeout_s)
                     self.cells[k] = nh
+                    self.timeline_rec.annotate("cell_restart", cell=k)
                 except Exception:
                     continue  # next sweep retries, counted above
 
@@ -437,6 +462,100 @@ class CellSupervisor:
     def _trace_route(self, tid: Optional[str]) -> dict:
         # /trace -> recent ids; /trace/<tid> -> one merged timeline
         return self.trace(tid)
+
+    # -------------------------------------------------- health plane (ISSUE 18)
+    def healthz(self) -> dict:
+        """Host-level readiness: 200 only when every cell's current
+        incarnation is up AND answers ok (not draining, WAL healthy) —
+        the body names the cell that isn't."""
+        cells = {}
+        ok = not self._stopping
+        for k, h in sorted(self.cells.items()):
+            doc = {"up": h.alive()}
+            if doc["up"]:
+                try:
+                    doc.update(h.healthz(timeout=10))
+                except Exception:
+                    doc["up"] = False
+            cells[str(k)] = doc
+            if not (doc["up"] and doc.get("ok", False)):
+                ok = False
+        return {"ok": ok, "cells": cells}
+
+    def health(self) -> Optional[dict]:
+        """Merged group-health summary across cells (the `/health` body):
+        counts sum, maxima max, top-K lists re-rank with a cell tag.
+        None (404) when no cell runs the health fold."""
+        docs = []
+        for k, h in sorted(self.cells.items()):
+            if not h.alive():
+                continue
+            try:
+                d = h.health(timeout=15)
+            except Exception:
+                continue
+            if d:
+                d["cell"] = k
+                docs.append(d)
+        if not docs:
+            return None
+        merged = {
+            "cells": {str(d["cell"]): d.get("clock", 0) for d in docs},
+            "allocated": sum(d.get("allocated", 0) for d in docs),
+            "backlogged": sum(d.get("backlogged", 0) for d in docs),
+            "wedged": sum(d.get("wedged", 0) for d in docs),
+            "max_stall_ticks": max(d.get("max_stall_ticks", 0)
+                                   for d in docs),
+            "max_churn": max(d.get("max_churn", 0) for d in docs),
+            "wedge_ticks": max(d.get("wedge_ticks", 0) for d in docs),
+        }
+        for key in ("top_stuck", "top_churny", "top_hot"):
+            # per-cell lists are already K-bounded; re-rank the union so
+            # the host view is the top n_cells*K with cell provenance
+            rows = [dict(e, cell=d["cell"])
+                    for d in docs for e in d.get(key, [])]
+            rows.sort(key=lambda e: -e["value"])
+            merged[key] = rows
+        hists = [d for d in docs if "hist_stall" in d]
+        if hists:
+            merged["hist_stall"] = [
+                sum(h["hist_stall"][i] for h in hists)
+                for i in range(len(hists[0]["hist_stall"]))]
+        return merged
+
+    def group_info(self, name: str) -> Optional[dict]:
+        """Resolve ``name`` to its owner cell (override map first, static
+        hash second — the same directory the edge uses) and drill down
+        there; the answer is tagged with the owning cell."""
+        k = self.router.cell(name)
+        h = self.cells.get(k)
+        if h is None or not h.alive():
+            return {"name": name, "cell": k, "error": "cell down"}
+        try:
+            doc = h.group(name, timeout=15)
+        except Exception as e:
+            return {"name": name, "cell": k,
+                    "error": f"{type(e).__name__}: {e}"}
+        if doc is None:
+            return None
+        doc["cell"] = k
+        return doc
+
+    def timeline(self) -> dict:
+        """Merged scenario timeline (the `/timeline` body): every live
+        cell's sampled series plus this supervisor's lifecycle events
+        (cell deaths, respawns, fd verdicts) on one wall clock."""
+        from ..obs.timeline import merge_timelines
+
+        snaps = [self.timeline_rec.snapshot()]
+        for k, h in sorted(self.cells.items()):
+            if not h.alive():
+                continue
+            try:
+                snaps.append(h.timeline(timeout=15))
+            except Exception:
+                pass  # a cell dying mid-dump only narrows the timeline
+        return merge_timelines(snaps)
 
     # ----------------------------------------------------------------- stop
     def stop(self) -> None:

@@ -186,6 +186,9 @@ def main() -> None:
         os._exit(3)
 
     _tick_driver_mod.FATAL_HANDLER = _wal_failstop
+    # group-health transitions (newly wedged/recovered, top-K churn) land
+    # in the same ring — a SIGKILL'd cell's dump names its sick groups
+    cluster.manager.flight = flight
     reporter = StatsReporter(
         f"c{cell}", interval_s=float(spec.get("stats_interval_s", 2.0)),
         sink=flight.snapshot_sink)
@@ -194,6 +197,36 @@ def main() -> None:
     reporter.add_source("transport", transport_stats_source(ar0.m.transport))
     reporter.add_source("shards", shard_load_source(cluster.manager))
     reporter.start()
+
+    # scenario timeline (ISSUE 18): sampled metric series vs wall clock,
+    # with event annotations; the supervisor merges every cell's snapshot
+    # into one host-level /timeline body (ROADMAP item 5's instrument)
+    from gigapaxos_tpu.obs.timeline import TimelineRecorder, registry_sampler
+
+    timeline = TimelineRecorder(
+        registry_sampler(
+            "health_backlogged_groups", "health_wedged_groups",
+            "overload_admission_shed_total", "overload_expired_drops_total",
+            "reads_local_total", "tick_seconds"),
+        interval_s=float(spec.get("timeline_interval_s", 0.25)),
+        node=f"c{cell}")
+    timeline.start()
+    timeline.annotate("boot", cell=cell, pid=os.getpid())
+    # readiness state for the healthz command (503 while draining or after
+    # a sticky WAL failure — supervisors stop routing, diagnostics stay up)
+    ready_state = {"draining": False}
+
+    def _healthz_doc() -> dict:
+        wal_failed = any(
+            getattr(getattr(p, "wal", None), "failed", False)
+            for p in (cluster.manager, cluster.rc_manager))
+        return {
+            "ok": not ready_state["draining"] and not wal_failed,
+            "cell": cell,
+            "tick": int(cluster.manager.tick_num),
+            "draining": ready_state["draining"],
+            "wal_failed": wal_failed,
+        }
 
     # migrated-name directory for edge routing, updated by `override` lines
     overrides: dict = {str(k): int(v)
@@ -297,10 +330,21 @@ def main() -> None:
                 emit("trace " + json.dumps(dump))
             elif cmd == "flight":
                 emit("flight " + flight.dump("rpc"))
+            elif cmd == "healthz":
+                emit("healthz " + json.dumps(_healthz_doc(),
+                                             sort_keys=True))
+            elif cmd == "health":
+                emit("health " + json.dumps(m.health_snapshot()))
+            elif cmd == "group":
+                emit("group " + json.dumps(m.group_info(parts[1])))
+            elif cmd == "timeline":
+                emit("timeline " + json.dumps(timeline.snapshot()))
             elif cmd == "ledger":
                 with _LEDGER_LOCK:
                     emit("ledger " + json.dumps(_LEDGER))
             elif cmd == "drain":
+                ready_state["draining"] = True
+                timeline.annotate("drain", cell=cell)
                 ok = cluster.drain(float(spec.get("drain_timeout_s", 10.0)))
                 emit("drained " + ("ok" if ok else "timeout"))
             elif cmd == "override":
@@ -325,6 +369,7 @@ def main() -> None:
                 if blob is None:
                     emit(f"migrate_err {name} drain_timeout")
                 else:
+                    timeline.annotate("migrate_out", name=name, cell=cell)
                     emit(f"migrated_out {name} {epoch} {blob.hex()}")
             elif cmd == "migrate_in":
                 name, epoch = parts[1], int(parts[2])
@@ -336,6 +381,7 @@ def main() -> None:
                               name, epoch, blob, active_ids, row))
                 if ok:
                     overrides.pop(name, None)  # we ARE the owner now
+                    timeline.annotate("migrate_in", name=name, cell=cell)
                     emit(f"migrated_in {name} {epoch}")
                 else:
                     emit(f"migrate_err {name} no_row")
@@ -350,6 +396,7 @@ def main() -> None:
             emit(f"err {cmd} {type(e).__name__}: {e}")
 
     reporter.stop()
+    timeline.stop()
     flight.dump("graceful_exit")
     fd.close()
     if edge_m is not None:

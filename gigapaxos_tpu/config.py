@@ -205,6 +205,22 @@ class PaxosTuning:
     # clock runs up to margin ticks slow still stops serving reads before
     # any conflicting write can be acked.
     lease_margin_ticks: int = 8
+    # Group-health plane (ISSUE 18): per-group last-commit age,
+    # coordinator-churn score, wedge detection and intake heat folded
+    # inside the fused tick, reduced on device into log2 histograms +
+    # scalar gauges + top-K anomaly columns (one O(K) host pull per tick).
+    # Observation-only: the fold never feeds back into consensus, and with
+    # the flag off the tick programs are the literal pre-health functions,
+    # bit for bit (the read_leases=off pattern).
+    group_health: bool = False
+    # Top-K rows shipped per criterion (stuckest / churniest / hottest).
+    health_topk: int = 8
+    # A group with device-visible backlog and no commit/exec progress for
+    # this many consecutive ticks counts as wedged.
+    health_wedge_ticks: int = 32
+    # EWMA decay shift for the churn/heat scores: per tick each score
+    # loses 1/2**shift of itself (shift 6 ~ a 64-tick window).
+    health_decay_shift: int = 6
     # Tick coalescing: minimum spacing between driver ticks while busy.
     # Each tick has a fixed host cost (admission, placement, compaction
     # unpack); spacing ticks lets requests accumulate so that cost
@@ -231,6 +247,21 @@ class PaxosTuning:
                 f"lease_margin_ticks must be >= 0, got "
                 f"{self.lease_margin_ticks}"
             )
+        if self.group_health:
+            if self.health_topk < 1:
+                raise ValueError(
+                    f"health_topk must be >= 1, got {self.health_topk}"
+                )
+            if self.health_wedge_ticks < 1:
+                raise ValueError(
+                    f"health_wedge_ticks must be >= 1, got "
+                    f"{self.health_wedge_ticks}"
+                )
+            if not (0 <= self.health_decay_shift <= 15):
+                raise ValueError(
+                    f"health_decay_shift must be in [0, 15], got "
+                    f"{self.health_decay_shift}"
+                )
         if self.compact_outbox and self.proposals_per_tick > 31:
             # taken_bits packs the P intake slots into one int32 lane
             raise ValueError(
@@ -404,6 +435,9 @@ class ObsConfig:
     # the WAL / base dir of whatever plane hosts the recorder).
     flight_cap: int = 256
     flight_dir: str = ""
+    # Scenario timeline recorder sample interval (obs/timeline.py); the
+    # /timeline route serves the sampled series + event annotations.
+    timeline_interval_s: float = 0.25
 
     def __post_init__(self) -> None:
         if self.flight_cap < 8:

@@ -282,6 +282,32 @@ class ModeBNode(ModeBCommon):
             "reads_fallback_total",
             help="reads that fell back to a consensus round (no/invalid "
                  "lease or non-quiescent group)", node=self._ov_node)
+        # ---- group-health plane (ISSUE 18, host-numpy Mode-B twin) ----
+        # Mode A folds health on device; a per-process node mirrors the
+        # same stall/churn/heat definitions over its completed-tick view
+        # (queues + outstanding = backlog; own exec progress = activity;
+        # coordinator-view handoffs = churn), so /health and the flight
+        # transitions read the same either way.  OFF by default — the fold
+        # is pure observation and adds one vectorized pass per tick.
+        self._group_health = bool(cfg.paxos.group_health)
+        self._health_topk = int(cfg.paxos.health_topk)
+        self._health_wedge = int(cfg.paxos.health_wedge_ticks)
+        self._health_shift = int(cfg.paxos.health_decay_shift)
+        self._h_last_active = np.zeros(self.G, np.int64)
+        self._h_churn = np.zeros(self.G, np.int32)  # Q4 fixed point
+        self._h_heat = np.zeros(self.G, np.int32)   # Q4 fixed point
+        self._h_view: Optional[dict] = None
+        self._wedged_rows: set = set()
+        #: optional FlightRecorder set by the serving layer (server.py)
+        self.flight = None
+        self._hg_backlog = _obsreg2().gauge(
+            "health_backlogged_groups",
+            help="groups with queued or outstanding work (health fold)",
+            node=self._ov_node)
+        self._hg_wedged = _obsreg2().gauge(
+            "health_wedged_groups",
+            help="backlogged groups with no exec progress for at least "
+                 "health_wedge_ticks ticks", node=self._ov_node)
         self.lock = ContendedLock()
         # ---- device-resident application (models/device_kv.py) ----
         # The per-process deployment twin of Mode A's device_app
@@ -1108,6 +1134,10 @@ class ModeBNode(ModeBCommon):
     def _process_outbox(self, out, placed=None, extras=None) -> None:
         if self._read_leases:
             self._lease_fold(np.asarray(out.coord_id))
+        if self._group_health:
+            # before _coord_view adopts the new view, so handoff detection
+            # still sees the previous tick's coordinators
+            self._health_fold(out)
         self._coord_view = out.coord_id
         taken = out.intake_taken[self.r]  # [P, G]
         for row, take in (self._placed if placed is None else placed):
@@ -1190,6 +1220,124 @@ class ModeBNode(ModeBCommon):
                 self._lease_fence[took],
                 now + self._lease_horizon + self._lease_margin)
         self._lease_prev_coord = coord.astype(np.int32, copy=True)
+
+    def _health_fold(self, out) -> None:
+        """Host-numpy twin of the Mode-A device health fold (ISSUE 18):
+        same stall/churn/heat definitions over the completed tick's
+        outbox.  Backlog = queued intake or placed-but-unresponded work;
+        activity = our own exec progress (or no backlog at all); churn
+        counts coordinator handoffs in the pre-adoption view as a
+        shift-decayed Q4 EWMA, exactly like the device fold."""
+        now = self.tick_num
+        coord = np.asarray(out.coord_id)
+        prev = self._coord_view
+        backlog = np.zeros(self.G, bool)
+        for row, q in self._queues.items():
+            if q and row < self.G:
+                backlog[row] = True
+        for rec in self.outstanding.values():
+            if rec.row < self.G:
+                backlog[rec.row] = True
+        progress = np.asarray(out.exec_count[self.r]) > 0
+        self._h_last_active[progress | ~backlog] = now
+        handoff = (coord >= 0) & (prev >= 0) & (coord != prev)
+        sh = self._health_shift
+        self._h_churn += (handoff.astype(np.int32) << 4) - \
+            (self._h_churn >> sh)
+        taken = np.asarray(out.intake_taken[self.r])  # [P, G]
+        self._h_heat += (taken.sum(axis=0, dtype=np.int32) << 4) - \
+            (self._h_heat >> sh)
+        stall = np.where(backlog, now - self._h_last_active, 0)
+        wedged_mask = backlog & (stall >= self._health_wedge)
+        K = min(self._health_topk, self.G)
+        top = np.argsort(-stall, kind="stable")[:K]
+        stall_by_row = {int(r): int(stall[r]) for r in top if stall[r] > 0}
+        wedged_now = {r for r, v in stall_by_row.items()
+                      if v >= self._health_wedge}
+        self._hg_backlog.set(int(backlog.sum()))
+        self._hg_wedged.set(int(wedged_mask.sum()))
+        if self.flight is not None:
+            for r in sorted(wedged_now - self._wedged_rows):
+                self.flight.record("group_wedged", {
+                    "row": r, "name": self.rows.name(r),
+                    "stall_ticks": stall_by_row[r], "tick": now})
+            for r in sorted(self._wedged_rows - wedged_now):
+                self.flight.record("group_recovered", {
+                    "row": r, "name": self.rows.name(r), "tick": now})
+        self._wedged_rows = wedged_now
+
+        def _top_list(vals):
+            idx = np.argsort(-vals, kind="stable")[:K]
+            return [{"row": int(r), "name": self.rows.name(int(r)),
+                     "value": float(vals[r])}
+                    for r in idx if vals[r] > 0]
+
+        self._h_view = {
+            "clock": int(now),
+            "allocated": len(self.rows),
+            "backlogged": int(backlog.sum()),
+            "wedged": int(wedged_mask.sum()),
+            "max_stall_ticks": int(stall.max()) if self.G else 0,
+            "max_churn": float(self._h_churn.max()) / 16.0 if self.G else 0,
+            "wedge_ticks": self._health_wedge,
+            "top_stuck": _top_list(stall),
+            "top_churny": _top_list(self._h_churn / 16.0),
+            "top_hot": _top_list(self._h_heat / 16.0),
+        }
+
+    def health_snapshot(self) -> Optional[dict]:
+        """JSON view of the last completed tick's health fold (the
+        ``/health`` route body; None when the fold is off)."""
+        return self._h_view
+
+    def group_info(self, name: str) -> Optional[dict]:
+        """Single-group drill-down, Mode-B flavor: this node's row view
+        (coordinator, pending intake, lease fence/holdership, health
+        columns) — the per-process analog of PaxosManager.group_info."""
+        row = self.rows.row(name)
+        if row is None and "#" not in name:
+            best = None  # bare service name -> highest resident epoch
+            for pname in self.rows.names():
+                base, sep, etxt = pname.rpartition("#")
+                if base == name and sep and etxt.isdigit():
+                    if best is None or int(etxt) > best:
+                        best = int(etxt)
+            if best is not None:
+                name = f"{name}#{best}"
+                row = self.rows.row(name)
+        if row is None:
+            return None
+        meta = self._row_meta.get(int(row))
+        info = {
+            "name": name,
+            "row": int(row),
+            "mode": "log",
+            "members": (list(meta[1]) if meta is not None else None),
+            "epoch": (int(meta[2]) if meta is not None else None),
+            "coordinator": int(self._coord_view[row]),
+            "pending_intake": len(self._queues.get(row) or ()),
+            "tick": int(self.tick_num),
+        }
+        if self._read_leases:
+            info["lease"] = {
+                "until": int(self._lease_until[row]),
+                "fence": int(self._lease_fence[row]),
+                "holder": (self.r if self.tick_num
+                           < int(self._lease_until[row]) else -1),
+            }
+        if self._group_health:
+            info["health"] = {
+                "stall_ticks": int(self.tick_num
+                                   - self._h_last_active[row]),
+                "churn": float(self._h_churn[row]) / 16.0,
+                "heat": float(self._h_heat[row]) / 16.0,
+            }
+        if self.wal is not None and hasattr(self.wal, "tail_for_row"):
+            try:
+                info["wal_tail"] = self.wal.tail_for_row(int(row), name)
+            except Exception:
+                info["wal_tail"] = None
+        return info
 
     def read(
         self,

@@ -178,10 +178,126 @@ def _lease_clear_rows_impl(lease: LeaseState, rows):
 lease_clear_rows = jax.jit(_lease_clear_rows_impl, donate_argnums=(0,))
 
 
+class HealthState(NamedTuple):
+    """Group-health columns (ISSUE 18): dense ``[G]`` per-group health
+    facts folded inside the fused tick, so "which of a million groups is
+    sick" is answered by an on-device reduction instead of an O(G) host
+    pull.  Observation-only: nothing here ever feeds back into the
+    consensus dataflow, so the journal bytes of a health-on run are
+    identical to a health-off run.
+
+    Time is the tick clock (one tick = one unit, the LeaseState
+    convention), so every column is a pure function of (state, inbox)
+    and WAL replay reproduces it bit for bit.
+
+    clock:       int32 []  — health clock; +1 per tick.
+    last_active: int32 [G] — last tick the group made commit/exec progress
+                 OR had no device-visible backlog (an idle group is
+                 healthy); ``clock - last_active`` is the stall age.
+    last_coord:  int32 [G] — last effective coordinator observed (-1 until
+                 a first election); the churn detector's memory.
+    churn:       int32 [G] — decaying coordinator-handoff score, Q4 fixed
+                 point (one handoff adds 16; each tick decays by
+                 ``1/2**decay_shift`` of the current value).
+    heat:        int32 [G] — decaying offered-intake EWMA, Q4 fixed point
+                 (the "hottest rows" ranking key).
+    """
+
+    clock: jnp.ndarray
+    last_active: jnp.ndarray
+    last_coord: jnp.ndarray
+    churn: jnp.ndarray
+    heat: jnp.ndarray
+
+
+def init_health(n_groups: int) -> HealthState:
+    return HealthState(
+        clock=jnp.zeros((), I32),
+        last_active=jnp.zeros((n_groups,), I32),
+        last_coord=jnp.full((n_groups,), -1, I32),
+        churn=jnp.zeros((n_groups,), I32),
+        heat=jnp.zeros((n_groups,), I32),
+    )
+
+
+def _health_clear_rows_impl(health: HealthState, rows):
+    """Reset health columns for freed/migrated rows: a recycled row must
+    not inherit the previous occupant's stall age or churn score.
+    Out-of-range rows (padding) are dropped."""
+    return health._replace(
+        last_active=health.last_active.at[rows].set(health.clock,
+                                                    mode="drop"),
+        last_coord=health.last_coord.at[rows].set(-1, mode="drop"),
+        churn=health.churn.at[rows].set(0, mode="drop"),
+        heat=health.heat.at[rows].set(0, mode="drop"),
+    )
+
+
+health_clear_rows = jax.jit(_health_clear_rows_impl, donate_argnums=(0,))
+
+
+#: health_pack gauge indices (see :class:`HealthLayout`)
+(HG_ALLOC, HG_BACKLOG, HG_WEDGED, HG_MAX_STALL, HG_MAX_CHURN,
+ HG_LEASE_WAIT) = range(6)
+HG_N = 6
+#: log2 histogram buckets in the health pack — bucket i holds values with
+#: ``int(v).bit_length() == i`` (the obs/metrics.py convention), bucket 31
+#: is the overflow tail
+HB = 32
+
+
+def _log2_hist(v, mask):
+    """[G] int32 values -> [HB] bucket counts over ``mask`` rows, bucketed
+    by bit_length (matches obs/metrics.py Histogram).
+
+    Computed as 31 vectorized ``>= 2^i`` count-sums and an adjacent diff
+    rather than a scatter-add: bucket ``i+1`` (values in ``[2^i, 2^(i+1))``)
+    is ``ge[i] - ge[i+1]`` and bucket 0 is the masked zero count.  Exact
+    same counts, ~3x cheaper on CPU where 1-element scatter-adds over a
+    million rows serialize."""
+    vv = jnp.where(mask, jnp.maximum(v, 0), -1)  # masked negatives: bucket 0
+    ge = jnp.stack([jnp.sum(vv >= (1 << i), dtype=I32)
+                    for i in range(HB - 1)])
+    n0 = jnp.sum(vv == 0, dtype=I32)
+    counts = ge - jnp.concatenate([ge[1:], jnp.zeros(1, I32)])
+    return jnp.concatenate([n0[None], counts])
+
+
+def _health_pack_impl(stall, churn, heat, backlog, allocated, wait_n,
+                      wedge_ticks: int, topk: int):
+    """Reduce the [G] health columns into the flat host summary described
+    by :class:`HealthLayout`: scalar gauges, two log2 histograms, and the
+    top-K (value, row) columns per anomaly criterion."""
+    wedged = allocated & (stall >= wedge_ticks)
+    gauges = jnp.stack([
+        jnp.sum(allocated.astype(I32)),
+        jnp.sum(backlog.astype(I32)),
+        jnp.sum(wedged.astype(I32)),
+        jnp.max(jnp.where(allocated, stall, 0)),
+        jnp.max(jnp.where(allocated, churn, 0)),
+        wait_n,
+    ]).astype(I32)
+    parts = [gauges, _log2_hist(stall, allocated),
+             _log2_hist(churn >> 4, allocated)]
+    for v in (stall, churn, heat):
+        # rank in f32: XLA CPU's TopK has a vectorized f32 path but falls
+        # back to a ~100x slower generic sort for int32.  Values clamp at
+        # 2^24 (exact in f32) — ranking saturates there, far beyond any
+        # plausible stall age or Q4 churn/heat score
+        vf = jnp.where(allocated, jnp.minimum(v, 1 << 24), -1).astype(
+            jnp.float32)
+        tv, ti = jax.lax.top_k(vf, topk)
+        parts += [tv.astype(I32), ti.astype(I32)]
+    return jnp.concatenate(parts)
+
+
 def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
                     exec_budget: int = 0, group_axis: str | None = None,
                     fast_elect: bool = False, lease: LeaseState | None = None,
-                    lease_horizon: int = 0):
+                    lease_horizon: int = 0,
+                    health: HealthState | None = None,
+                    wedge_ticks: int = 32, health_decay_shift: int = 6,
+                    health_topk: int = 8):
     """Un-jitted tick body (jit/shard it yourself; `paxos_tick` below is the
     ready-made single-program jit with state donation).
 
@@ -860,7 +976,55 @@ def paxos_tick_impl(state, inbox: TickInbox, own_row: int = -1,
         lease_pack = jnp.stack([
             l_holder, l_epoch, l_until, asn, lease_wait.astype(I32),
         ])
+    if health is not None:
+        # ---- group health fold (ISSUE 18) ----
+        # Read-only w.r.t. consensus: every input below is a fact the tick
+        # already computed.  Device-visible backlog = offered intake (the
+        # host re-places rejected requests every tick, so a wedged group
+        # keeps offering), an assignment frontier ahead of the exec
+        # frontier, or an election that has not resolved — which covers
+        # the quorum-lost case where intake is never admitted at all.
+        hclock = health.clock + 1
+        allocated = jnp.any(member, axis=0)  # [G]
+        offered = jnp.any(req_flat != NO_REQUEST, axis=0)  # [G]
+        asn_h = jnp.max(jnp.where(member, new_state.next_slot, 0), axis=0)
+        done_h = jnp.max(jnp.where(member, new_state.exec_slot, 0), axis=0)
+        electing = jnp.any(
+            member & alive[:, None] & new_state.coord_preparing, axis=0
+        )
+        backlog = (offered | (asn_h > done_h) | electing) & allocated
+        progress = (decided_now > 0) | (jnp.max(n_exec, axis=0) > 0)
+        h_last_active = jnp.where(progress | ~backlog, hclock,
+                                  health.last_active)
+        # coordinator churn: count real handoffs only — a first election
+        # is not churn, and a coordinatorless gap collapses into the one
+        # handoff its resolution is
+        w_eff = jnp.where(has_coord, w_c, -1)
+        handoff = has_coord & (health.last_coord >= 0) & (
+            w_eff != health.last_coord
+        )
+        h_last_coord = jnp.where(has_coord, w_eff, health.last_coord)
+        sh = jnp.int32(health_decay_shift)
+        h_churn = (health.churn - (health.churn >> sh)
+                   + (handoff.astype(I32) << 4))
+        offered_n = jnp.sum((req_flat != NO_REQUEST).astype(I32), axis=0)
+        h_heat = health.heat - (health.heat >> sh) + (offered_n << 4)
+        new_health = HealthState(hclock, h_last_active, h_last_coord,
+                                 h_churn, h_heat)
+        stall = jnp.where(allocated & backlog, hclock - h_last_active, 0)
+        wait_n = (jnp.sum(lease_wait.astype(I32)) if lease is not None
+                  else jnp.zeros((), I32))
+        health_pack = _health_pack_impl(
+            stall, h_churn, h_heat, backlog, allocated, wait_n,
+            wedge_ticks, health_topk,
+        )
+    if lease is not None and health is not None:
+        return (new_state, outbox, new_lease, lease_pack, new_health,
+                health_pack)
+    if lease is not None:
         return new_state, outbox, new_lease, lease_pack
+    if health is not None:
+        return new_state, outbox, new_health, health_pack
     return new_state, outbox
 
 
@@ -1432,3 +1596,168 @@ def merge_compact_outbox(co_l: CompactHostOutbox, co_r: CompactHostOutbox,
         l_dstat=cat([co_l.l_dstat, co_r.l_dstat]),
         l_lexec=cat([co_l.l_lexec, co_r.l_lexec]),
     )
+
+
+# --------------------------------------------------------------------------
+# Group-health plane (ISSUE 18): the host side of the health fold above —
+# the flat health_pack layout, its unpack, the composite-plane merge, and
+# the single generic health tick entry point that covers every dispatch
+# combination (compact/packed x lease/plain x mixed/single) without a
+# twin-per-combination explosion.  Health-off builds never import any of
+# this into their dispatch: the off program is the literal pre-health
+# function, bit for bit.
+# --------------------------------------------------------------------------
+
+
+class HealthLayout:
+    """Single source of truth for the flat health_pack buffer (the
+    :class:`CompactLayout` discipline): ``gauges[HG_N] | hist_stall[HB] |
+    hist_churn[HB] | (val[K], row[K]) x (stuck, churny, hot)``."""
+
+    def __init__(self, topk: int):
+        self.K = topk
+        self.o_hist_stall = HG_N
+        self.o_hist_churn = self.o_hist_stall + HB
+        self.o_top = self.o_hist_churn + HB
+        self.total = self.o_top + 6 * topk
+
+
+class HealthView(NamedTuple):
+    """Host (numpy) view of one tick's health pack: the needle-finding
+    summary the manager mirrors each tick at O(K) transfer cost."""
+
+    alloc: int          # allocated groups
+    backlog: int        # groups with device-visible backlog this tick
+    wedged: int         # backlogged groups stalled >= wedge_ticks
+    max_stall: int      # worst stall age (ticks)
+    max_churn: int      # worst churn score (Q4 fixed point)
+    lease_wait: int     # coordinators write-fenced behind a prior lease
+    hist_stall: "np.ndarray"  # [HB] log2 buckets of stall age
+    hist_churn: "np.ndarray"  # [HB] log2 buckets of handoff score (whole)
+    stuck_val: "np.ndarray"   # [K] desc; -1 entries = fewer than K rows
+    stuck_row: "np.ndarray"
+    churn_val: "np.ndarray"
+    churn_row: "np.ndarray"
+    heat_val: "np.ndarray"
+    heat_row: "np.ndarray"
+
+
+def unpack_health(flat, topk: int) -> HealthView:
+    """Host-side inverse of :func:`_health_pack_impl` (zero-copy views)."""
+    flat = np.asarray(flat)
+    L = HealthLayout(topk)
+    o = L.o_top
+    cols = []
+    for _ in range(6):
+        cols.append(flat[o:o + topk])
+        o += topk
+    return HealthView(
+        alloc=int(flat[HG_ALLOC]),
+        backlog=int(flat[HG_BACKLOG]),
+        wedged=int(flat[HG_WEDGED]),
+        max_stall=int(flat[HG_MAX_STALL]),
+        max_churn=int(flat[HG_MAX_CHURN]),
+        lease_wait=int(flat[HG_LEASE_WAIT]),
+        hist_stall=flat[L.o_hist_stall:L.o_hist_stall + HB],
+        hist_churn=flat[L.o_hist_churn:L.o_hist_churn + HB],
+        stuck_val=cols[0], stuck_row=cols[1],
+        churn_val=cols[2], churn_row=cols[3],
+        heat_val=cols[4], heat_row=cols[5],
+    )
+
+
+def _merge_top(val_l, row_l, val_r, row_r, g_log: int, topk: int):
+    """Merge two planes' top-K columns into composite-row top-K: register
+    rows re-offset by g_log, then one partial sort over 2K entries."""
+    vals = np.concatenate([val_l, val_r])
+    rows = np.concatenate([row_l, row_r + g_log])
+    order = np.argsort(-vals, kind="stable")[:topk]
+    return vals[order], rows[order]
+
+
+def merge_health(hv_l: HealthView, hv_r: HealthView, g_log: int,
+                 topk: int) -> HealthView:
+    """Compose the two planes' health views into the composite row space
+    (counts sum, maxima max, histograms add, top-K re-ranks)."""
+    sv, sr = _merge_top(hv_l.stuck_val, hv_l.stuck_row,
+                        hv_r.stuck_val, hv_r.stuck_row, g_log, topk)
+    cv, cr = _merge_top(hv_l.churn_val, hv_l.churn_row,
+                        hv_r.churn_val, hv_r.churn_row, g_log, topk)
+    hv, hr = _merge_top(hv_l.heat_val, hv_l.heat_row,
+                        hv_r.heat_val, hv_r.heat_row, g_log, topk)
+    return HealthView(
+        alloc=hv_l.alloc + hv_r.alloc,
+        backlog=hv_l.backlog + hv_r.backlog,
+        wedged=hv_l.wedged + hv_r.wedged,
+        max_stall=max(hv_l.max_stall, hv_r.max_stall),
+        max_churn=max(hv_l.max_churn, hv_r.max_churn),
+        lease_wait=hv_l.lease_wait + hv_r.lease_wait,
+        hist_stall=hv_l.hist_stall + hv_r.hist_stall,
+        hist_churn=hv_l.hist_churn + hv_r.hist_churn,
+        stuck_val=sv, stuck_row=sr,
+        churn_val=cv, churn_row=cr,
+        heat_val=hv, heat_row=hr,
+    )
+
+
+def _paxos_tick_health_impl(state, rstate, lease, rlease, health, rhealth,
+                            inbox: TickInbox, own_row: int, exec_budget: int,
+                            lag_budget: int, lease_horizon: int,
+                            compact: bool, wedge_ticks: int,
+                            decay_shift: int, topk: int):
+    """The one health-build tick program: ticks the log plane (and the
+    register plane when ``rstate`` is present), folds lease columns when
+    present, folds health columns per plane, and packs the outbox compact
+    or full per the static ``compact`` flag.  Absent planes/folds pass
+    None and collapse out of the traced program (the empty-pytree
+    property), so one jit covers the whole dispatch tree the non-health
+    manager spells out explicitly.
+
+    Returns a fixed 12-tuple
+    ``(state, rstate, lease, rlease, health, rhealth,
+       out_l, out_r, lp_l, lp_r, hp_l, hp_r)``
+    with None in every absent position."""
+
+    def _plane(st, ib, le, he, k):
+        res = paxos_tick_impl(
+            st, ib, own_row, exec_budget, lease=le,
+            lease_horizon=lease_horizon, health=he, wedge_ticks=wedge_ticks,
+            health_decay_shift=decay_shift, health_topk=k,
+        )
+        st2, out = res[0], res[1]
+        i = 2
+        le2 = lp = he2 = hp = None
+        if le is not None:
+            le2, lp = res[i], res[i + 1]
+            i += 2
+        if he is not None:
+            he2, hp = res[i], res[i + 1]
+        pk = (_compact_outbox_impl(out, exec_budget, lag_budget)
+              if compact else pack_outbox_impl(out))
+        return st2, le2, he2, pk, lp, hp
+
+    g_log = state.exec_slot.shape[1]
+    if rstate is not None:
+        ib_l, ib_r = _split_inbox(inbox, g_log)
+        k_r = min(topk, rstate.exec_slot.shape[1])
+    else:
+        ib_l, ib_r = inbox, None
+        k_r = 0
+    k_l = min(topk, g_log)
+    state, lease, health, pk_l, lp_l, hp_l = _plane(
+        state, ib_l, lease, health, k_l)
+    pk_r = lp_r = hp_r = None
+    if rstate is not None:
+        rstate, rlease, rhealth, pk_r, lp_r, hp_r = _plane(
+            rstate, ib_r, rlease, rhealth, k_r)
+    return (state, rstate, lease, rlease, health, rhealth,
+            pk_l, pk_r, lp_l, lp_r, hp_l, hp_r)
+
+
+#: health twin covering every single-device dispatch combination.  Note
+#: the per-plane top-K is ``min(topk, G_plane)`` — the host unpacks with
+#: the same clamp (see ``PaxosManager._adopt_health_pack``).
+paxos_tick_health = jax.jit(
+    _paxos_tick_health_impl, donate_argnums=(0, 1, 2, 3, 4, 5),
+    static_argnums=(7, 8, 9, 10, 11, 12, 13, 14),
+)

@@ -51,15 +51,16 @@ from .bulkstore import BulkOverrun, BulkStore
 from .paystore import PayloadStore
 from ..ops.tick import (LP_ASN, LP_EPOCH, LP_HOLDER, LP_UNTIL, LP_WAIT,
                         CompactHostOutbox, HostOutbox, TickInbox,
-                        frontier_rows, lease_clear_rows,
-                        merge_compact_outbox, merge_outbox,
-                        paxos_tick_compact, paxos_tick_compact_demand,
-                        paxos_tick_compact_lease, paxos_tick_mixed_compact,
+                        frontier_rows, health_clear_rows, init_health,
+                        lease_clear_rows, merge_compact_outbox, merge_health,
+                        merge_outbox, paxos_tick_compact,
+                        paxos_tick_compact_demand, paxos_tick_compact_lease,
+                        paxos_tick_health, paxos_tick_mixed_compact,
                         paxos_tick_mixed_compact_lease,
                         paxos_tick_mixed_packed,
                         paxos_tick_mixed_packed_lease, paxos_tick_packed,
                         paxos_tick_packed_lease, sweep_frontier,
-                        unpack_compact, unpack_outbox)
+                        unpack_compact, unpack_health, unpack_outbox)
 
 
 @dataclass
@@ -350,7 +351,8 @@ class PaxosManager:
 
                 self._demand_dev = _stk2.init_demand(self.mesh, self.G)
             elif self._use_compact and not self._device_app \
-                    and not self.G_reg and not cfg.paxos.read_leases:
+                    and not self.G_reg and not cfg.paxos.read_leases \
+                    and not cfg.paxos.group_health:
                 # single-device compact path: the intake-popcount fold runs
                 # fused inside paxos_tick_compact_demand (no mesh, so the
                 # GSPMD same-jit hazard doesn't apply) instead of the old
@@ -358,7 +360,9 @@ class PaxosManager:
                 # Mixed planes keep the host fold: placement demand covers
                 # the LOG plane only (register rows never migrate shards).
                 # Lease builds keep the host fold too — the lease tick
-                # variants carry lease state instead of the demand array.
+                # variants carry lease state instead of the demand array —
+                # as do health builds (the generic health twin has no
+                # demand formulation).
                 self._demand_dev = jnp.zeros(self.G, jnp.float32)
         # ---- leader-lease plane (ISSUE 17) ----
         # Dense [G]/[G_reg] lease columns folded inside the fused tick:
@@ -392,6 +396,38 @@ class PaxosManager:
                             if self.G_reg else None)
             self._lease_np = np.zeros((5, self.G_total), np.int32)
             self._lease_np[0, :] = -1  # holder column: -1 = none
+        # ---- group-health plane (ISSUE 18) ----
+        # Dense per-group stall/churn/heat columns folded inside the fused
+        # tick; the host consumes only an O(K) health pack per tick (scalar
+        # gauges + log2 histograms + top-K anomaly rows).  Observation-only:
+        # nothing here feeds back into consensus, and with the flag off the
+        # tick programs are the literal pre-health functions, bit for bit.
+        self._health = None
+        self._rhealth = None
+        self._health_view = None      # HealthView as of last completed tick
+        self._health_clock = 0        # host lockstep clock (+1/completed tick)
+        self._health_topk = int(cfg.paxos.health_topk)
+        self._health_wedge = int(cfg.paxos.health_wedge_ticks)
+        self._health_shift = int(cfg.paxos.health_decay_shift)
+        self._wedged_rows: set = set()   # last tick's wedged top-K rows
+        self._topk_stuck: tuple = ()     # last tick's stuck top-K rows
+        #: optional FlightRecorder set by the serving layer; health-state
+        #: transitions (newly wedged/recovered, top-K churn) land in its
+        #: ring so a SIGKILL'd cell's dump names its last-known sick groups
+        self.flight = None
+        if cfg.paxos.group_health:
+            if self._device_app:
+                raise ValueError(
+                    "group_health + device_app is not supported yet: the "
+                    "fused KV program has no health formulation"
+                )
+            if cfg.paxos.mesh_devices:
+                raise ValueError(
+                    "group_health + mesh_devices is not supported yet: the "
+                    "shard_map tick has no health formulation"
+                )
+            self._health = init_health(self.G)
+            self._rhealth = init_health(self.G_reg) if self.G_reg else None
         # first-occurrence scratch (generation-tagged so no per-tick clear)
         self._scr_pos = np.zeros(self.R * self.G_total, np.int64)
         self._scr_gen = np.zeros(self.R * self.G_total, np.int64)
@@ -457,6 +493,30 @@ class PaxosManager:
             help="per-tick count of groups whose coordinator is write-"
                  "fenced waiting out a prior holder's lease",
             node=self._ov_node)
+        # group-health gauge families (ISSUE 18; WIRING-gated).  Scalars
+        # only: the histograms and top-K columns travel on the JSON
+        # /health route, not the Prometheus scrape.
+        self._hg_backlog = _obsreg().gauge(
+            "health_backlogged_groups",
+            help="groups with pending intake, an unexecuted assignment "
+                 "frontier, or an unresolved election (health fold)",
+            node=self._ov_node)
+        self._hg_wedged = _obsreg().gauge(
+            "health_wedged_groups",
+            help="backlogged groups with no commit/exec progress for at "
+                 "least health_wedge_ticks ticks", node=self._ov_node)
+        self._hg_max_stall = _obsreg().gauge(
+            "health_max_stall_ticks",
+            help="largest per-group stall age (ticks since last progress "
+                 "among backlogged groups)", node=self._ov_node)
+        self._hg_max_churn = _obsreg().gauge(
+            "health_max_churn",
+            help="largest per-group coordinator-churn EWMA (handoffs over "
+                 "a decaying window)", node=self._ov_node)
+        self._hg_lease_wait = _obsreg().gauge(
+            "health_lease_wait_groups",
+            help="groups write-fenced behind a prior holder's lease this "
+                 "tick (0 when leases are off)", node=self._ov_node)
         self.lock = ContendedLock()
         if self.wal is not None:
             self.wal.attach(self)
@@ -572,6 +632,189 @@ class PaxosManager:
             "asn": int(lp[LP_ASN, row]),
             "clock": self._lease_clock,
         }
+
+    # ----------------------------------------------------------- health plane
+    # (ISSUE 18) Host side of the group-health fold.  The device owns the
+    # dense stall/churn/heat columns; the host consumes one O(K) pack per
+    # completed tick — scalar gauges, log2 histograms, and the top-K
+    # stuckest/churniest/hottest rows — so finding the sick needles among
+    # a million rows never costs an O(G) transfer.
+
+    def _adopt_health_pack(self, health_pack) -> None:
+        """Consume one tick's health pack(s) at completion (the device
+        sync point, so the pack describes the tick that just finished).
+        Mixed planes hand a (log, register) pair merged with register
+        rows re-offset into the composite row space."""
+        K = self._health_topk
+        if isinstance(health_pack, tuple):
+            hv = merge_health(
+                unpack_health(np.asarray(health_pack[0]), min(K, self.G)),
+                unpack_health(np.asarray(health_pack[1]),
+                              min(K, self.G_reg)),
+                self.G, K)
+        else:
+            hv = unpack_health(np.asarray(health_pack), min(K, self.G))
+        self._health_view = hv
+        self._health_clock += 1  # lockstep with the device fold's clock+1
+        self._hg_backlog.set(int(hv.backlog))
+        self._hg_wedged.set(int(hv.wedged))
+        self._hg_max_stall.set(int(hv.max_stall))
+        self._hg_max_churn.set(int(hv.max_churn) / 16.0)  # Q4 -> handoffs
+        self._hg_lease_wait.set(int(hv.lease_wait))
+        # transition detection -> flight ring: a SIGKILL'd cell's dump
+        # should name its last-known sick groups, so newly wedged rows,
+        # recoveries, and top-K membership churn are recorded as events
+        stall_by_row = {int(r): int(v)
+                        for v, r in zip(hv.stuck_val, hv.stuck_row)
+                        if int(v) > 0}
+        wedged_now = {r for r, v in stall_by_row.items()
+                      if v >= self._health_wedge}
+        stuck_now = tuple(sorted(stall_by_row))
+        if self.flight is not None:
+            for r in sorted(wedged_now - self._wedged_rows):
+                self.flight.record("group_wedged", {
+                    "row": r, "name": self.rows.name(r),
+                    "stall_ticks": stall_by_row[r],
+                    "tick": self.tick_num})
+            for r in sorted(self._wedged_rows - wedged_now):
+                self.flight.record("group_recovered", {
+                    "row": r, "name": self.rows.name(r),
+                    "tick": self.tick_num})
+            if stuck_now != self._topk_stuck:
+                self.flight.record("health_topk", {
+                    "stuck_rows": list(stuck_now), "tick": self.tick_num})
+        self._wedged_rows = wedged_now
+        self._topk_stuck = stuck_now
+
+    def _health_drop_rows(self, rows) -> None:
+        """Reset health columns for freed rows (remove/pause/migration): a
+        recycled row must not inherit the previous occupant's stall age or
+        churn window.  Same padded-batch clear as _lease_drop_rows."""
+        if self._health is None or not len(rows):
+            return
+        if self._pending_out is not None:
+            # a pending tick's health_pack predates this drop; complete it
+            # first so adoption cannot resurrect the dropped row
+            self.drain_pipeline()
+        rows = np.asarray(rows, np.int32)
+        lrows = rows[rows < self.G]
+        rrows = rows[rows >= self.G] - np.int32(self.G)
+        if len(lrows):
+            self._health = health_clear_rows(
+                self._health, _pad_rows(lrows, self.G))
+        if len(rrows) and self._rhealth is not None:
+            self._rhealth = health_clear_rows(
+                self._rhealth, _pad_rows(rrows, self.G_reg))
+
+    @_locked
+    def health_snapshot(self) -> Optional[dict]:
+        """JSON-friendly view of the last completed tick's health pack
+        (the ``/health`` route body; None when the fold is off or no tick
+        has completed).  Top-K rows are resolved back to group names."""
+        hv = self._health_view
+        if hv is None:
+            return None
+
+        def _top(vals, rs, scale=1):
+            return [{"row": int(r), "name": self.rows.name(int(r)),
+                     "value": int(v) / scale}
+                    for v, r in zip(vals, rs) if int(v) > 0]
+
+        return {
+            "clock": self._health_clock,
+            "allocated": int(hv.alloc),
+            "backlogged": int(hv.backlog),
+            "wedged": int(hv.wedged),
+            "max_stall_ticks": int(hv.max_stall),
+            "max_churn": int(hv.max_churn) / 16.0,
+            "lease_wait": int(hv.lease_wait),
+            "wedge_ticks": self._health_wedge,
+            "hist_stall": [int(x) for x in hv.hist_stall],
+            "hist_churn": [int(x) for x in hv.hist_churn],
+            "top_stuck": _top(hv.stuck_val, hv.stuck_row),
+            "top_churny": _top(hv.churn_val, hv.churn_row, scale=16),
+            "top_hot": _top(hv.heat_val, hv.heat_row, scale=16),
+        }
+
+    @_locked
+    def group_info(self, name: str) -> Optional[dict]:
+        """Upstream-style single-group drill-down (the dense analog of
+        printing one PaxosInstanceStateMachine): ballot, frontiers, member
+        liveness, lease columns, register version, pending intake, health
+        columns, and a bounded WAL tail — all from row-gathers, no O(G)
+        host work.  None when the group is not resident here.
+
+        Accepts either the epoch-qualified paxos name (``svc#3``) or the
+        bare service name — the latter resolves to the highest resident
+        epoch, the same answer the reconfigurator's live-epoch map gives."""
+        row = self.rows.row(name)
+        if row is None and "#" not in name:
+            prefix, best = name + "#", None
+            for pname in self.rows.names():
+                base, sep, etxt = pname.rpartition("#")
+                if base == name and sep and etxt.isdigit():
+                    if best is None or int(etxt) > best:
+                        best = int(etxt)
+            if best is not None:
+                name = prefix + str(best)
+                row = self.rows.row(name)
+        if row is None:
+            return None
+        pst, prow = self._plane_state(row)
+        register = row >= self.G
+        member = np.asarray(pst.member[:, prow])
+        bal_n = np.asarray(pst.bal_num[:, prow])
+        bal_c = np.asarray(pst.bal_coord[:, prow])
+        exec_s = np.asarray(pst.exec_slot[:, prow])
+        next_s = np.asarray(pst.next_slot[:, prow])
+        status = np.asarray(pst.status[:, prow])
+        coord_a = np.asarray(pst.coord_active[:, prow])
+        coord_p = np.asarray(pst.coord_preparing[:, prow])
+        members = [int(r) for r in np.nonzero(member)[0]]
+        replicas = {
+            int(r): {
+                "alive": bool(self.alive[r]),
+                "ballot": [int(bal_n[r]), int(bal_c[r])],
+                "exec_slot": int(exec_s[r]),
+                "next_slot": int(next_s[r]),
+                "status": int(status[r]),
+                "coordinator": bool(coord_a[r]),
+                "preparing": bool(coord_p[r]),
+            }
+            for r in members
+        }
+        info = {
+            "name": name,
+            "row": int(row),
+            "mode": "register" if register else "log",
+            "epoch": int(np.asarray(pst.epoch[prow])),
+            "members": members,
+            "replicas": replicas,
+            "stopped": row in self._stopped_rows,
+            "pending_intake": len(self._queues.get(row) or ())
+            + int(self._row_outstanding[row]),
+            "tick": self.tick_num,
+        }
+        if register and members:
+            # register-plane rows carry one in-place value; the executed
+            # slot IS its monotone version counter (RMWPaxos)
+            info["version"] = max(int(exec_s[r]) for r in members)
+        if self._lease_np is not None:
+            info["lease"] = self.lease_info(name)
+        if self._health is not None:
+            h = self._rhealth if register else self._health
+            info["health"] = {
+                "stall_ticks": int(h.clock) - int(h.last_active[prow]),
+                "coordinator": int(h.last_coord[prow]),
+                "churn": int(h.churn[prow]) / 16.0,
+                "heat": int(h.heat[prow]) / 16.0,
+            }
+        if self.wal is not None:
+            try:
+                info["wal_tail"] = self.wal.tail_for_row(row, name)
+            except Exception:
+                info["wal_tail"] = None
+        return info
 
     def read(
         self,
@@ -798,6 +1041,7 @@ class PaxosManager:
         self._kv_clear_rows([row])
         self._clear_member_rows([row])
         self._lease_drop_rows([row])
+        self._health_drop_rows([row])
         self.rows.free(name)
         self._fail_queued(row)
         self._purge_row_outstanding(row)
@@ -1008,6 +1252,7 @@ class PaxosManager:
         self._kv_clear_rows(rows_to_free)
         self._clear_member_rows(rows_to_free)
         self._lease_drop_rows(rows_to_free)
+        self._health_drop_rows(rows_to_free)
         for name in names:
             row = self.rows.free(name)
             self._stopped_rows.discard(row)
@@ -1882,11 +2127,30 @@ class PaxosManager:
         placed = self._placed
         bulk_placed = self._bulk_placed
         lease_pack = None
+        health_pack = None
         # dispatch first, journal second: the jitted step runs asynchronously
         # while the WAL appends+fsyncs this tick's record (SURVEY §2.2 item 3,
         # the BatchedLogger overlap, AbstractPaxosLogger.java:99-107).  Safe
         # because responses stay held until is_synced() (log-before-respond).
-        if self._device_app:
+        if self._health is not None:
+            # health builds: ONE generic jit covers every single-device
+            # combination (compact/packed x lease x mixed planes) — absent
+            # planes pass None and collapse out of the traced program.
+            # device_app and mesh raise at init, so they never reach here.
+            (self.state, self.rstate, self._lease, self._rlease,
+             self._health, self._rhealth, pk_l, pk_r, lp_l, lp_r,
+             hp_l, hp_r) = paxos_tick_health(
+                self.state, self.rstate, self._lease, self._rlease,
+                self._health, self._rhealth, inbox, -1,
+                self._exec_budget if self._use_compact else 0,
+                self._lag_budget, self._lease_horizon,
+                self._use_compact, self._health_wedge,
+                self._health_shift, self._health_topk)
+            packed = pk_l if pk_r is None else (pk_l, pk_r)
+            if lp_l is not None:
+                lease_pack = lp_l if lp_r is None else (lp_l, lp_r)
+            health_pack = hp_l if hp_r is None else (hp_l, hp_r)
+        elif self._device_app:
             from ..models.device_kv import fused_compact
 
             self.state, self.kv, packed = fused_compact(
@@ -2033,21 +2297,21 @@ class PaxosManager:
                 # completed outbox on sync-due ticks
                 out, self._drained_out = self._drained_out, None
             self._pending_out = (packed, placed, bulk_placed, frontier,
-                                 lease_pack)
+                                 lease_pack, health_pack)
             # a due checkpoint must cover on-host effects of every tick the
             # device state contains — drain the one-tick pipeline first
             if self.wal is not None and self.wal.checkpoint_due():
                 self.drain_pipeline()
         else:
             out = self._complete_tick(packed, placed, bulk_placed, frontier,
-                                      lease_pack)
+                                      lease_pack, health_pack)
         if self.wal is not None:
             self.wal.maybe_checkpoint()
         pc.end()
         return out
 
     def _complete_tick(self, packed, placed: list, bulk_placed=None,
-                       frontier=None, lease_pack=None):
+                       frontier=None, lease_pack=None, health_pack=None):
         """Consume one tick's outbox (unpacking = the device sync point):
         requeue rejected intake, execute the ordered decision stream,
         release durable callbacks, periodic GC."""
@@ -2057,6 +2321,8 @@ class PaxosManager:
         pc.touch()
         if lease_pack is not None:
             self._adopt_lease_pack(lease_pack)
+        if health_pack is not None:
+            self._adopt_health_pack(health_pack)
         if self._use_compact:
             if isinstance(packed, tuple):
                 # mixed planes: two per-plane compact buffers; unpack each

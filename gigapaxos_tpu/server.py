@@ -101,6 +101,8 @@ class ModeBServer:
             self.reporter = StatsReporter(node_id, cfg.stats_interval_s)
         self.node: Optional[ModeBNode] = None
         self.rc_node: Optional[ModeBNode] = None
+        self.timeline_rec = None
+        self._closing = False
         self.active_replica: Optional[ActiveReplica] = None
         self.reconfigurator: Optional[Reconfigurator] = None
         self.app: Optional[Replicable] = None
@@ -237,6 +239,9 @@ class ModeBServer:
             self.flight.record("boot", node=node_id, pid=os.getpid())
             if self.reporter is not None:
                 self.reporter.sink = self.flight.snapshot_sink
+            if self.node is not None:
+                # health fold records wedge/recover transitions here
+                self.node.flight = self.flight
         if obs is not None and obs.http_port >= 0:
             from .obs import registry as _obs_registry
             from .obs.http import MetricsServer
@@ -256,8 +261,38 @@ class ModeBServer:
             if self.flight is not None:
                 fr = self.flight
                 flight_cb = lambda: fr.read(fr.persist())  # noqa: E731
+
+            # health plane (ISSUE 18): readiness + group drill-down served
+            # off the data-plane node; the RC plane is control traffic and
+            # reports only through /healthz's wal check
+            def _wal_failed() -> bool:
+                for n in (self.node, getattr(self, "rc_node", None)):
+                    if n is not None and getattr(n, "wal", None) is not None:
+                        if getattr(n.wal, "failed", False):
+                            return True
+                return False
+
+            def _healthz() -> dict:
+                return {"ok": not _wal_failed() and not self._closing,
+                        "node": node_id, "draining": self._closing,
+                        "wal_failed": _wal_failed()}
+
+            health_cb = group_cb = None
+            if self.node is not None:
+                health_cb = self.node.health_snapshot
+                group_cb = self.node.group_info
+            from .obs.timeline import TimelineRecorder, registry_sampler
+            self.timeline_rec = TimelineRecorder(
+                registry_sampler(
+                    "health_backlogged_groups", "health_wedged_groups",
+                    "overload_admission_shed_total", "tick_seconds"),
+                interval_s=obs.timeline_interval_s,
+                node=node_id).start()
+            self.timeline_rec.annotate("boot", node=node_id)
             self.metrics_server = MetricsServer(
                 _scrape, trace=_trace, flight=flight_cb,
+                healthz=_healthz, health=health_cb, group=group_cb,
+                timeline=self.timeline_rec.snapshot,
                 port=obs.http_port)
 
         if self.reporter is not None:
@@ -343,6 +378,9 @@ class ModeBServer:
         return all(d.wait_ready(timeout_s) for d in self.drivers)
 
     def close(self) -> None:
+        self._closing = True
+        if self.timeline_rec is not None:
+            self.timeline_rec.stop()
         if self.metrics_server is not None:
             self.metrics_server.close()
         if self.reporter is not None:

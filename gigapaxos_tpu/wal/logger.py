@@ -439,6 +439,65 @@ class PaxosLogger:
             (OP_SYNC, r, name, donor, donor_exec, donor_status, ckpt)
         ))
 
+    # ------------------------------------------------------- drill-down scan
+    def tail_for_row(self, row: int, name: str, max_records: int = 8,
+                     max_journals: int = 2) -> list:
+        """Bounded newest-last scan of recent journaled ops touching one
+        group (ISSUE 18 ``/group/<name>`` drill-down).  The WAL journals
+        INBOXES, not decisions, so the tail names the group's recent
+        intake placements and admin ops — "what was this group last asked
+        to do, and when" — without replaying anything.  Reads at most
+        ``max_journals`` journal files, returns at most ``max_records``
+        entries, and treats every decode error as end-of-scan: this is an
+        observability read, never a recovery path.
+        """
+        import collections as _collections
+
+        out: _collections.deque = _collections.deque(maxlen=max_records)
+        paths = sorted(glob.glob(os.path.join(self.dir, "journal.*.log")))
+        for path in paths[-max_journals:]:
+            try:
+                scan = scan_journal(path)
+            except Exception:
+                continue
+            for raw in scan.records:
+                try:
+                    rec = records.loads(raw)
+                except Exception:
+                    break
+                op = rec[0]
+                if op in (OP_TICK, OP_REG):
+                    placed = rec[2]
+                    for r, entries in placed:
+                        if r != row:
+                            continue
+                        out.append({
+                            "op": "tick" if op == OP_TICK else "reg",
+                            "tick": int(rec[1]),
+                            "placed": [
+                                {"rid": int(e[0]), "entry": int(e[1]),
+                                 "lane": int(e[2]), "stop": bool(e[4]),
+                                 "bytes": (len(e[3]) if isinstance(
+                                     e[3], (bytes, bytearray)) else None)}
+                                for e in entries],
+                        })
+                elif op in (OP_CREATE, OP_CREATE_AT) and rec[1] == name:
+                    out.append({"op": "create", "members": list(rec[2]),
+                                "epoch": int(rec[3]),
+                                "row": (int(rec[4]) if op == OP_CREATE_AT
+                                        else None)})
+                elif op == OP_REMOVE and rec[1] == name:
+                    out.append({"op": "remove"})
+                elif op == OP_PAUSE and name in rec[1]:
+                    out.append({"op": "pause"})
+                elif op == OP_UNPAUSE and rec[1] == name:
+                    out.append({"op": "unpause"})
+                elif op == OP_SYNC and rec[2] == name:
+                    out.append({"op": "sync", "replica": int(rec[1]),
+                                "donor": int(rec[3]),
+                                "donor_exec": int(rec[4])})
+        return list(out)
+
     def _ref_payload(self, pl):
         """Journal-side payload dedup: the first time a body is journaled
         in this checkpoint epoch its raw bytes go out; every later

@@ -1,0 +1,482 @@
+"""Group health plane tests (ISSUE 18): needle-in-a-million detection.
+
+Mode A: per-group last-commit age, coordinator churn, wedged detection
+and lease-wait pressure are folded INSIDE the fused tick and reduced on
+device into log2 histograms + scalar gauges + top-K anomaly columns, so
+the host learns which of a million groups are sick at O(K)/tick.
+Mode B keeps a numpy host twin of the same fold.
+
+Covered here: wedge detection across dispatch modes, top-K extraction
+naming the sick row, flight-recorder wedge/recover transitions, the
+single-group drill-down (``group_info``) including bare-name epoch
+resolution and the WAL tail, row-lifecycle clearing, the ``merge_health``
+composite, config gates, the ``group_health`` off bit-identity guarantee
+(journal bytes identical with the fold on or off), and a chaos-driven
+Mode B scenario where a quorum-loss wedge surfaces in the top-K within a
+bounded number of ticks and clears on recovery.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gigapaxos_tpu.config import GigapaxosTpuConfig
+from gigapaxos_tpu.models.replicable import KVApp
+from gigapaxos_tpu.modeb import ModeBNode
+from gigapaxos_tpu.obs.flight import FlightRecorder
+from gigapaxos_tpu.ops.tick import HB, HealthView, merge_health
+from gigapaxos_tpu.paxos.manager import PaxosManager
+from gigapaxos_tpu.testing.chaos import (ChaosEvent, ChaosSchedule,
+                                         SimChaosRunner)
+from gigapaxos_tpu.testing.simnet import SimNet
+from gigapaxos_tpu.wal.logger import PaxosLogger
+
+
+def mk_cfg(G=8, G_reg=0, compact=False, pipeline=False, health=True,
+           wedge=4, topk=4, leases=False):
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = G
+    cfg.paxos.register_groups = G_reg
+    cfg.paxos.compact_outbox = compact
+    cfg.paxos.pipeline_ticks = pipeline
+    cfg.paxos.group_health = health
+    cfg.paxos.health_wedge_ticks = wedge
+    cfg.paxos.health_topk = topk
+    if leases:
+        cfg.paxos.read_leases = True
+        cfg.paxos.lease_ticks = 16
+        cfg.paxos.lease_margin_ticks = 4
+    return cfg
+
+
+def pump(m, n):
+    for _ in range(n):
+        m.tick()
+    m.drain_pipeline()
+
+
+# ------------------------------------------------------------ mode A basics
+
+@pytest.mark.parametrize("compact,pipeline,g_reg",
+                         [(False, False, 0), (False, True, 0),
+                          (True, False, 4), (True, True, 4)])
+def test_wedge_detected_in_topk(compact, pipeline, g_reg):
+    """THE needle: kill a quorum under one of several groups, offer it
+    work, and the health fold must name that row in top_stuck within
+    wedge_ticks + a small pipeline slack — in every dispatch mode."""
+    m = PaxosManager(mk_cfg(compact=compact, pipeline=pipeline,
+                            G_reg=g_reg), 3, [KVApp() for _ in range(3)])
+    for i in range(3):
+        m.create_paxos_instance(f"svc{i}", [0, 1, 2])
+    for i in range(3):
+        m.propose(f"svc{i}", b"PUT k v")
+    pump(m, 6)
+    h = m.health_snapshot()
+    assert h is not None and h["allocated"] >= 3
+    assert h["wedged"] == 0
+
+    m.set_alive(1, False)
+    m.set_alive(2, False)
+    m.propose("svc1", b"PUT k w")  # offered work that cannot commit
+    detected_at = None
+    for t in range(4 + 2 * 4 + 8):  # wedge_ticks=4 + slack
+        pump(m, 1)
+        h = m.health_snapshot()
+        stuck = [e["name"] for e in h["top_stuck"]]
+        if h["wedged"] >= 1 and "svc1" in stuck:
+            detected_at = t
+            break
+    assert detected_at is not None, m.health_snapshot()
+    assert h["backlogged"] >= 1
+    assert h["max_stall_ticks"] >= 4
+    # the log2 stall histogram sees the sick group in a nonzero bucket
+    assert sum(h["hist_stall"][1:]) >= 1
+    # healthy groups did not wedge
+    assert h["wedged"] <= 3
+
+    # quorum back: the group must drain and leave the wedged set
+    m.set_alive(1, True)
+    m.set_alive(2, True)
+    for _ in range(30):
+        pump(m, 1)
+        if m.health_snapshot()["wedged"] == 0:
+            break
+    assert m.health_snapshot()["wedged"] == 0
+
+
+def test_flight_records_wedge_and_recover_transitions(tmp_path):
+    """Health transitions feed the crash flight recorder: newly wedged
+    and newly recovered groups each leave one event."""
+    m = PaxosManager(mk_cfg(), 3, [KVApp() for _ in range(3)])
+    m.flight = FlightRecorder(str(tmp_path / "f.json"), node="t")
+    m.create_paxos_instance("svc", [0, 1, 2])
+    m.propose("svc", b"PUT k v")
+    pump(m, 4)
+    m.set_alive(1, False)
+    m.set_alive(2, False)
+    m.propose("svc", b"PUT k w")
+    pump(m, 12)
+    m.set_alive(1, True)
+    m.set_alive(2, True)
+    pump(m, 20)
+    doc = FlightRecorder.read(m.flight.persist())
+    kinds = [e["kind"] for e in doc["events"]]
+    assert "group_wedged" in kinds
+    assert "group_recovered" in kinds
+    wedge_ev = next(e for e in doc["events"] if e["kind"] == "group_wedged")
+    assert wedge_ev["name"] == "svc"
+    assert wedge_ev["stall_ticks"] >= 4
+
+
+def test_coordinator_churn_counted():
+    """Coordinator handoffs raise the churn EWMA for exactly the flapped
+    group; stable groups stay at zero churn."""
+    m = PaxosManager(mk_cfg(wedge=16), 4, [KVApp() for _ in range(4)])
+    m.create_paxos_instance("flappy", [0, 1, 2])
+    m.create_paxos_instance("calm", [1, 2, 3])  # no member flaps
+    for n in ("flappy", "calm"):
+        m.propose(n, b"PUT k v")
+    pump(m, 6)
+    for _ in range(3):  # kill / revive the coordinator: forced handoffs
+        m.set_alive(0, False)
+        pump(m, 10)
+        m.set_alive(0, True)
+        pump(m, 10)
+    h = m.health_snapshot()
+    churny = {e["name"]: e["value"] for e in h["top_churny"]}
+    assert churny.get("flappy", 0) > 0
+    assert h["max_churn"] > 0
+    gi = m.group_info("calm")
+    assert gi["health"]["churn"] == 0.0
+
+
+def test_group_info_drilldown_and_wal_tail(tmp_path):
+    """The ``/group/<name>`` body: full replica table from one row-gather,
+    health columns, pending intake, and a bounded WAL tail naming the
+    recent journal records that touched the row."""
+    cfg = mk_cfg(leases=True)
+    wal = PaxosLogger(os.path.join(str(tmp_path), "wal"))
+    m = PaxosManager(cfg, 3, [KVApp() for _ in range(3)], wal=wal)
+    m.create_paxos_instance("svc", [0, 1, 2])
+    for i in range(4):
+        m.propose("svc", f"PUT k v{i}".encode())
+        pump(m, 2)
+    gi = m.group_info("svc")
+    assert gi["name"] == "svc" and gi["mode"] == "log"
+    assert gi["members"] == [0, 1, 2]
+    assert set(gi["replicas"]) == {0, 1, 2}
+    r0 = gi["replicas"][0]
+    assert r0["alive"] and r0["exec_slot"] >= 4
+    assert sum(1 for r in gi["replicas"].values() if r["coordinator"]) == 1
+    assert gi["health"]["stall_ticks"] >= 0
+    assert "lease" in gi
+    ops = [rec["op"] for rec in gi["wal_tail"]]
+    assert "create" in ops or "tick" in ops
+    placed = [p for rec in gi["wal_tail"] if rec["op"] == "tick"
+              for p in rec["placed"]]
+    assert placed, gi["wal_tail"]
+    assert m.group_info("nope") is None
+    # the whole doc is JSON-serializable (it is an HTTP body)
+    json.dumps(gi)
+    wal.close()
+
+
+def test_health_cleared_on_remove_and_recreate():
+    """Row lifecycle: removing a wedged group drops its health columns —
+    no ghost needle through the row recycler."""
+    m = PaxosManager(mk_cfg(), 3, [KVApp() for _ in range(3)])
+    m.create_paxos_instance("svc", [0, 1, 2])
+    m.propose("svc", b"PUT k v")
+    pump(m, 4)
+    m.set_alive(1, False)
+    m.set_alive(2, False)
+    m.propose("svc", b"PUT k w")
+    pump(m, 10)
+    assert m.health_snapshot()["wedged"] == 1
+    m.set_alive(1, True)
+    m.set_alive(2, True)
+    m.remove_paxos_instance("svc")
+    pump(m, 2)
+    h = m.health_snapshot()
+    assert h["wedged"] == 0
+    assert all(e["name"] != "svc" for e in h["top_stuck"])
+    # the recycled row starts cold
+    m.create_paxos_instance("svc2", [0, 1, 2])
+    pump(m, 2)
+    gi = m.group_info("svc2")
+    assert gi["health"]["stall_ticks"] <= 2
+    assert gi["health"]["churn"] == 0.0
+
+
+def test_register_plane_health_and_merge():
+    """Mixed planes: a wedged register group surfaces through the same
+    top-K with its composite row id (register rows live above G_log)."""
+    m = PaxosManager(mk_cfg(compact=True, G_reg=4), 3,
+                     [KVApp() for _ in range(3)])
+    m.create_paxos_instance("log0", [0, 1, 2])
+    m.create_paxos_instance("reg0", [0, 1, 2], register=True)
+    m.propose("reg0", b"PUT k v")
+    m.propose("log0", b"PUT k v")
+    pump(m, 6)
+    h = m.health_snapshot()
+    assert h["allocated"] >= 2
+    names = {e["name"] for e in h["top_hot"]}
+    assert "reg0" in names or "log0" in names
+    gi = m.group_info("reg0")
+    assert gi["mode"] == "register"
+    assert "version" in gi
+
+
+def test_merge_health_unit():
+    """The two-plane composite: counts sum, maxima max, histograms add,
+    top-K re-ranks with register rows offset into composite row space."""
+    K = 4
+
+    def hv(vals, rows, alloc, hist0):
+        z = np.zeros(K, np.int32)
+        hist = np.zeros(HB, np.int32)
+        hist[0] = hist0
+        return HealthView(
+            alloc=alloc, backlog=1, wedged=1, max_stall=int(max(vals)),
+            max_churn=2, lease_wait=0,
+            hist_stall=hist, hist_churn=hist.copy(),
+            stuck_val=np.array(vals, np.int32),
+            stuck_row=np.array(rows, np.int32),
+            churn_val=z, churn_row=z.copy(),
+            heat_val=z.copy(), heat_row=z.copy())
+
+    left = hv([9, 3, 0, 0], [5, 1, 0, 0], 4, 2)
+    right = hv([7, 4, 0, 0], [2, 0, 0, 0], 2, 3)
+    g_log = 16
+    out = merge_health(left, right, g_log, K)
+    assert out.alloc == 6 and out.backlog == 2 and out.wedged == 2
+    assert out.max_stall == 9
+    assert int(out.hist_stall[0]) == 5
+    # 9@row5 (log), 7@row 16+2 (register), 4@row 16+0, 3@row1
+    assert list(out.stuck_val[:4]) == [9, 7, 4, 3]
+    assert list(out.stuck_row[:4]) == [5, g_log + 2, g_log + 0, 1]
+
+
+def test_health_config_gates():
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.group_health = True
+    cfg.paxos.health_topk = 0
+    with pytest.raises(ValueError):
+        cfg.paxos.__post_init__()
+    cfg2 = GigapaxosTpuConfig()
+    cfg2.paxos.group_health = True
+    cfg2.paxos.health_wedge_ticks = 0
+    with pytest.raises(ValueError):
+        cfg2.paxos.__post_init__()
+    cfg3 = GigapaxosTpuConfig()
+    cfg3.paxos.group_health = True
+    cfg3.paxos.device_app = True
+    with pytest.raises(ValueError):
+        PaxosManager(cfg3, 3, [KVApp() for _ in range(3)])
+
+
+# ----------------------------------------------------------- off = free
+
+def test_health_off_bit_identity(tmp_path):
+    """The flag-off guarantee and its stronger cousin: the fold is pure
+    observation — the log-plane state arrays and the journal BYTES are
+    identical with group_health on or off."""
+    results = []
+    for health, sub in ((False, "off"), (True, "on")):
+        cfg = mk_cfg(health=health, compact=True)
+        d = os.path.join(str(tmp_path), sub)
+        wal = PaxosLogger(d, checkpoint_every_ticks=1000)
+        m = PaxosManager(cfg, 3, [KVApp() for _ in range(3)], wal=wal)
+        m.create_paxos_instance("svc", [0, 1, 2])
+        for i in range(12):
+            m.propose("svc", f"PUT k{i} v{i}".encode())
+            m.tick()
+        pump(m, 8)
+        wal.close()
+        state = {f: np.asarray(getattr(m.state, f))
+                 for f in m.state._fields}
+        jpaths = sorted(p for p in os.listdir(d)
+                        if p.startswith("journal."))
+        blobs = [open(os.path.join(d, p), "rb").read() for p in jpaths]
+        results.append((state, jpaths, blobs))
+    (st_a, jp_a, bl_a), (st_b, jp_b, bl_b) = results
+    for f in st_a:
+        assert np.array_equal(st_a[f], st_b[f]), f
+    assert jp_a == jp_b
+    assert bl_a == bl_b
+
+
+# ------------------------------------------------------- mode B host twin
+
+IDS = ["N0", "N1", "N2"]
+
+
+def _build_modeb(seed):
+    net = SimNet(seed=seed)
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = 8
+    cfg.paxos.window = 8
+    cfg.paxos.group_health = True
+    cfg.paxos.health_wedge_ticks = 8
+    cfg.paxos.health_topk = 4
+    apps = {n: KVApp() for n in IDS}
+    nodes = {n: ModeBNode(cfg, IDS, n, apps[n], net.messenger(n),
+                          anti_entropy_every=8) for n in IDS}
+    for nd in nodes.values():
+        # epoch-qualified paxos names, as the reconfiguration layer makes
+        # them — the drill-down's bare-name resolution is exercised below
+        nd.create_group("svc#0", [0, 1, 2])
+        nd.create_group("bystander#0", [0, 1, 2])
+    return net, nodes, apps
+
+
+def test_modeb_chaos_wedge_detected_and_recovered(tmp_path):
+    """Chaos-driven detection, per-process twin: a scripted quorum loss
+    under one group wedges it; the surviving coordinator's health fold
+    must surface the row in top_stuck within wedge_ticks + detection
+    slack, record the flight transition, and clear it after recovery."""
+    sched = ChaosSchedule("quorum_loss_wedge", [
+        ChaosEvent(5, "propose",
+                   {"node": "N0", "group": "svc#0", "payload": "PUT k v1"}),
+        ChaosEvent(30, "crash", {"node": "N1", "detect_after": 2}),
+        ChaosEvent(31, "crash", {"node": "N2", "detect_after": 2}),
+        ChaosEvent(40, "propose",
+                   {"node": "N0", "group": "svc#0", "payload": "PUT k v2"}),
+        ChaosEvent(90, "recover", {"node": "N1"}),
+    ], seed=7)
+    net, nodes, apps = _build_modeb(seed=7)
+    fr = FlightRecorder(str(tmp_path / "f.json"), node="N0")
+    nodes["N0"].flight = fr
+    runner = SimChaosRunner(net, nodes, sched)
+
+    detect = {"at": None, "cleared": None}
+
+    def on_tick(t):
+        h = nodes["N0"].health_snapshot()
+        if h is None:
+            return
+        stuck = {e["name"] for e in h["top_stuck"]}
+        if (detect["at"] is None and h["wedged"] >= 1
+                and "svc#0" in stuck):
+            detect["at"] = t
+        if (detect["at"] is not None and detect["cleared"] is None
+                and t > 95 and h["wedged"] == 0):
+            detect["cleared"] = t
+
+    runner.run(160, on_tick=on_tick)
+    # bounded detection: wedge began when the quorum-less propose landed
+    # (tick 40); wedge_ticks=8 plus a small fold/FD slack
+    assert detect["at"] is not None, nodes["N0"].health_snapshot()
+    assert detect["at"] <= 40 + 8 + 12
+    assert detect["cleared"] is not None, nodes["N0"].health_snapshot()
+    # the undamaged group never wedged alongside
+    assert all(e["name"] != "bystander#0"
+               for e in nodes["N0"].health_snapshot()["top_stuck"])
+    kinds = [e["kind"] for e in FlightRecorder.read(fr.persist())["events"]]
+    assert "group_wedged" in kinds and "group_recovered" in kinds
+    # the committed write from before the outage stayed committed
+    assert runner.proposals[0]["resp"] == "OK"
+
+
+# ------------------------------------------------- 2-cell host e2e (slow)
+
+def _get(url, timeout=30.0, method="GET"):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(url, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+@pytest.mark.slow
+def test_two_cell_host_health_routes(tmp_path):
+    """The ISSUE 18 acceptance route check on a live 2-cell host:
+    ``/healthz`` aggregates per-cell readiness, ``/health`` merges both
+    cells' folds, ``/group/<name>`` resolves the OWNER cell through the
+    same directory the edge uses, ``/timeline`` merges cell series with
+    supervisor lifecycle events, and every route answers HEAD."""
+    from gigapaxos_tpu.cells.supervisor import CellSupervisor
+    from gigapaxos_tpu.config import CellsConfig
+
+    cc = CellsConfig(enabled=True, n_cells=2, n_actives=3,
+                     n_reconfigurators=1, pin_cores=False,
+                     restart_backoff_s=0.2)
+    sup = CellSupervisor(
+        str(tmp_path / "cells"), cells=cc,
+        paxos_overrides={"max_groups": 16, "group_health": True,
+                         "health_topk": 4},
+        http_port=0).start()
+    try:
+        c = sup.make_client()
+        # s0/s1 hash to cell 0, s4/s5 to cell 1 (crc32 % 2)
+        names = ["s0", "s1", "s4", "s5"]
+        for n in names:
+            assert c.create(n).get("ok"), n
+        for i, n in enumerate(names):
+            assert c.request(n, f"PUT k{i} v{i}".encode()) == b"OK"
+        url = sup.metrics_server.url
+
+        st, body = _get(url + "/healthz")
+        assert st == 200, body
+        doc = json.loads(body)
+        assert doc["ok"] and set(doc["cells"]) == {"0", "1"}
+        assert all(cd["up"] and cd["ok"] and not cd["draining"]
+                   and not cd["wal_failed"]
+                   for cd in doc["cells"].values())
+
+        st, body = _get(url + "/health")
+        assert st == 200, body
+        hd = json.loads(body)
+        assert hd["allocated"] == 4
+        assert hd["wedged"] == 0
+        # top lists carry the owning cell and both cells contributed
+        assert {e["cell"] for e in hd["top_hot"]} == {0, 1}
+
+        # drill-down finds each group on its OWNER cell
+        seen_cells = set()
+        for n in names:
+            st, body = _get(url + f"/group/{n}")
+            assert st == 200, (n, body)
+            gd = json.loads(body)
+            assert gd["name"].split("#")[0] == n
+            assert "replicas" in gd and "health" in gd
+            seen_cells.add(gd["cell"])
+        assert seen_cells == {0, 1}  # 4 names spread over both cells
+        st, _ = _get(url + "/group/doesnotexist")
+        assert st == 404
+
+        st, body = _get(url + "/timeline")
+        assert st == 200, body
+        tl = json.loads(body)
+        assert {"SUP", "c0", "c1"} <= set(tl["sources"])
+        assert any(e["kind"] == "boot" for e in tl["events"])
+        assert any(len(s["samples"]) > 0
+                   for k, s in tl["sources"].items() if k != "SUP")
+
+        for p in ("/metrics", "/healthz", "/health", "/group/s0",
+                  "/timeline"):
+            st, body = _get(url + p, method="HEAD")
+            assert st == 200 and body == "", (p, st)
+    finally:
+        sup.stop()
+
+
+def test_modeb_group_info_bare_name_and_health():
+    net, nodes, apps = _build_modeb(seed=3)
+    for _ in range(20):
+        for nd in nodes.values():
+            nd.tick()
+        net.pump()
+    gi = nodes["N0"].group_info("svc")  # bare name -> svc#0
+    assert gi is not None and gi["name"] == "svc#0"
+    assert gi["members"] == [0, 1, 2] and gi["epoch"] == 0
+    assert gi["coordinator"] in (0, 1, 2)
+    assert gi["health"]["stall_ticks"] >= 0
+    assert nodes["N0"].group_info("ghost") is None
+    json.dumps(gi)

@@ -129,6 +129,16 @@ WIRING = {
         "gigapaxos_tpu/cells/supervisor.py",
     "supervisor_heartbeat_timeout_seconds":
         "gigapaxos_tpu/cells/supervisor.py",
+    # group health plane (ISSUE 18): device-side fold gauges in the Mode A
+    # manager (the Mode B twin registers its own subset), and the scenario
+    # timeline recorder's sample/event counters
+    "health_backlogged_groups": "gigapaxos_tpu/paxos/manager.py",
+    "health_wedged_groups": "gigapaxos_tpu/paxos/manager.py",
+    "health_max_stall_ticks": "gigapaxos_tpu/paxos/manager.py",
+    "health_max_churn": "gigapaxos_tpu/paxos/manager.py",
+    "health_lease_wait_groups": "gigapaxos_tpu/paxos/manager.py",
+    "timeline_samples_total": "gigapaxos_tpu/obs/timeline.py",
+    "timeline_events_total": "gigapaxos_tpu/obs/timeline.py",
 }
 
 
@@ -147,15 +157,37 @@ def test_scrape_surfaces_are_wired():
     worker = _src("gigapaxos_tpu/cells/worker.py")
     # per-cell export over the control socket, cell-labelled
     assert "render_registry" in worker and '"cell": str(cell)' in worker
-    for cmd in ('cmd == "metrics"', 'cmd == "trace"', 'cmd == "flight"'):
+    for cmd in ('cmd == "metrics"', 'cmd == "trace"', 'cmd == "flight"',
+                'cmd == "healthz"', 'cmd == "health"', 'cmd == "group"',
+                'cmd == "timeline"'):
         assert cmd in worker, cmd
     sup = _src("gigapaxos_tpu/cells/supervisor.py")
     assert "merge_scrapes" in sup and "MetricsServer" in sup
+    assert "merge_timelines" in sup  # /timeline composes per-cell series
     server = _src("gigapaxos_tpu/server.py")
     assert "MetricsServer" in server and "FlightRecorder" in server
+    assert "TimelineRecorder" in server
     http = _src("gigapaxos_tpu/obs/http.py")
-    for route in ('"/metrics"', '"/trace"', '"/flight"'):
+    for route in ('"/metrics"', '"/trace"', '"/flight"', '"/healthz"',
+                  '"/health"', '"/group/"', '"/timeline"'):
         assert route in http, route
+
+
+def test_every_http_route_is_documented_in_module_docstring():
+    """Every route string obs/http.py serves must appear in its module
+    docstring — the docstring is the route inventory operators read, and
+    an undocumented route is an unowned surface."""
+    import gigapaxos_tpu.obs.http as http_mod
+
+    doc = http_mod.__doc__ or ""
+    src = _src("gigapaxos_tpu/obs/http.py")
+    handler = src[src.index("def do_GET"):src.index("do_HEAD")]
+    routes = set(re.findall(r'"(/[a-z]+/?)"', handler))
+    assert routes, "no routes parsed out of do_GET"
+    for route in routes:
+        assert route.rstrip("/") in doc, (
+            f"obs/http.py serves {route} but its module docstring does not "
+            f"document it")
 
 
 def test_readme_documents_the_observability_plane():

@@ -1,0 +1,166 @@
+"""Prometheus text-format lint (ISSUE 18 satellite): the scrape bodies a
+real Prometheus server would reject must never leave this repo.
+
+Checks, against BOTH a live single node's ``/metrics`` and a 2-cell
+host-level merged scrape:
+
+* no duplicate ``# HELP`` / ``# TYPE`` lines per family (Prometheus
+  hard-rejects the whole scrape on these);
+* every ``TYPE`` is a known type and precedes its family's samples;
+* sample lines parse (name, escaped label values, float value);
+* label values escape ``\\``, ``"`` and newlines;
+* histogram ``_bucket`` series are monotone non-decreasing in ``le``
+  (cumulative buckets), end at ``+Inf``, and ``+Inf == _count``;
+* no duplicate (name, labelset) sample within one body.
+"""
+
+import math
+import re
+
+import pytest
+
+from gigapaxos_tpu.obs.metrics import Registry
+from gigapaxos_tpu.obs.prom import merge_scrapes, render_registry
+
+SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'          # metric name
+    r'(\{(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*\})?'  # labels
+    r' (-?(?:[0-9.eE+-]+|Inf|NaN))$')       # value
+
+
+def lint(body: str) -> None:
+    """Assert ``body`` is a well-formed 0.0.4 exposition."""
+    seen_meta = set()
+    typed = {}
+    samples = {}
+    for ln in body.splitlines():
+        if not ln.strip():
+            continue
+        if ln.startswith("# HELP ") or ln.startswith("# TYPE "):
+            parts = ln.split()
+            kind, fam = parts[1], parts[2]
+            key = (kind, fam)
+            assert key not in seen_meta, f"duplicate metadata: {ln}"
+            seen_meta.add(key)
+            if kind == "TYPE":
+                t = parts[3]
+                assert t in ("counter", "gauge", "histogram", "summary",
+                             "untyped"), ln
+                typed[fam] = t
+            continue
+        assert not ln.startswith("#"), f"unknown comment line: {ln}"
+        m = SAMPLE_RE.match(ln)
+        assert m, f"unparseable sample line: {ln!r}"
+        name, labels, _val = m.group(1), m.group(2) or "", m.group(3)
+        key = (name, labels)
+        assert key not in samples, f"duplicate sample: {ln}"
+        samples[key] = float(_val)
+        assert "\n" not in labels
+    # histogram bucket monotonicity + +Inf == _count, per labelset
+    buckets = {}
+    for (name, labels), val in samples.items():
+        if not name.endswith("_bucket"):
+            continue
+        fam = name[:-len("_bucket")]
+        le = re.search(r'le="([^"]*)"', labels).group(1)
+        rest = re.sub(r'le="[^"]*",?', "", labels).rstrip(",}") or "{}"
+        buckets.setdefault((fam, rest), []).append(
+            (math.inf if le == "+Inf" else float(le), val))
+    for (fam, rest), bs in buckets.items():
+        bs.sort()
+        assert bs[-1][0] == math.inf, f"{fam}{rest}: no +Inf bucket"
+        vals = [v for _, v in bs]
+        assert vals == sorted(vals), \
+            f"{fam}{rest}: non-monotone buckets {vals}"
+        count = next((v for (n, l), v in samples.items()
+                      if n == fam + "_count"
+                      and l.rstrip(",}") == rest), None)
+        if count is not None:
+            assert vals[-1] == count, \
+                f"{fam}{rest}: +Inf {vals[-1]} != _count {count}"
+
+
+def _tricky_registry() -> Registry:
+    reg = Registry()
+    reg.counter("lint_total", help='has "quotes" and \\slashes\\',
+                node="n0", path='a"b\\c').inc(3)
+    reg.counter("lint_total", node="n0", path="plain").inc(1)
+    reg.gauge("lint_gauge", help="a gauge", node="n0").set(-2.5)
+    h = reg.histogram("lint_seconds", help="spread")
+    for v in (1e-5, 3e-4, 0.002, 0.002, 0.6, 11.0):
+        h.observe(v)
+    return reg
+
+
+def test_lint_rejects_known_bad_bodies():
+    with pytest.raises(AssertionError):
+        lint("# TYPE x counter\n# TYPE x counter\nx 1\n")
+    with pytest.raises(AssertionError):
+        lint('x{b="1} broken\n')
+    with pytest.raises(AssertionError):
+        lint("x 1\nx 2\n")
+    # non-monotone cumulative buckets
+    with pytest.raises(AssertionError):
+        lint('h_bucket{le="0.1"} 5\nh_bucket{le="1"} 3\n'
+             'h_bucket{le="+Inf"} 6\nh_count 6\n')
+
+
+def test_render_registry_lints_clean():
+    body = render_registry(_tricky_registry(),
+                           extra_labels={"node": "n0", "cell": "7"})
+    lint(body)
+    # the escaped label value round-trips
+    assert 'path="a\\"b\\\\c"' in body
+
+
+def test_merge_scrapes_lints_clean():
+    b0 = render_registry(_tricky_registry(), extra_labels={"cell": "0"})
+    b1 = render_registry(_tricky_registry(), extra_labels={"cell": "1"})
+    lint(merge_scrapes([b0, b1]))
+
+
+def test_live_node_scrape_lints_clean():
+    """A real PaxosManager's scrape (health fold on, leases on, work
+    done — histograms populated) passes the lint."""
+    from gigapaxos_tpu.models.replicable import KVApp
+    from gigapaxos_tpu.obs.metrics import registry
+    from gigapaxos_tpu.paxos.manager import PaxosManager
+    from tests.test_health import mk_cfg, pump
+
+    m = PaxosManager(mk_cfg(leases=True), 3, [KVApp() for _ in range(3)])
+    m.create_paxos_instance("svc", [0, 1, 2])
+    for i in range(8):
+        m.propose("svc", f"PUT k v{i}".encode())
+        pump(m, 2)
+    lint(render_registry(registry(), extra_labels={"node": "lint"}))
+
+
+@pytest.mark.slow
+def test_two_cell_merged_scrape_lints_clean(tmp_path):
+    """The host-level merged scrape over 2 live cells — the body a real
+    Prometheus server would ingest — passes the lint."""
+    import urllib.request
+
+    from gigapaxos_tpu.cells.supervisor import CellSupervisor
+    from gigapaxos_tpu.config import CellsConfig
+
+    cc = CellsConfig(enabled=True, n_cells=2, n_actives=3,
+                     n_reconfigurators=1, pin_cores=False,
+                     restart_backoff_s=0.2)
+    sup = CellSupervisor(
+        str(tmp_path / "cells"), cells=cc,
+        paxos_overrides={"max_groups": 16, "group_health": True},
+        http_port=0).start()
+    try:
+        c = sup.make_client()
+        for n in ("s0", "s4"):  # one group per cell
+            assert c.create(n).get("ok")
+            assert c.request(n, b"PUT k v") == b"OK"
+        with urllib.request.urlopen(sup.metrics_server.url + "/metrics",
+                                    timeout=60) as r:
+            body = r.read().decode("utf-8")
+        lint(body)
+        assert any(l.startswith("health_backlogged_groups")
+                   for l in body.splitlines())
+    finally:
+        sup.stop()

@@ -1,0 +1,272 @@
+"""Fast-restart artifact (ISSUE 19): time-to-full-service after a crash.
+
+The tentpole claim: columnar WAL decode + batched (sparse) replay
+dispatch turn recovery from O(ticks) host↔device round trips over a
+full-width plane into a handful of narrow scan programs, so a node with
+a huge group plane restarts in seconds, not minutes.  This bench
+measures it end to end at G ∈ {64k, 256k, 1M} and writes
+``benchmarks/results_recovery_pr19.json``:
+
+* ``t_ref_replay_s`` / ``t_batched_replay_s`` — wall time of journal
+  replay through the record-at-a-time reference arm vs the columnar
+  batched arm (sparse window dispatch engaged), same journal, fresh
+  process-equivalent manager each (gate: batched >= 5x at 1M);
+* ``bit_identical`` — the two recovered managers compare equal field by
+  field (state plane + apps + host bookkeeping);
+* ``t_first_served_s`` — crash-to-first-ack: batched replay plus live
+  ticks until a probe PUT on one group is executed and fsynced;
+* ``t_full_service_s`` — crash-to-all-served: until a probe on EVERY
+  journaled group has been acked;
+* ``peer_stream`` — parallel peer snapshot streaming: Mode B recovery
+  fetching checkpoint blobs from two donors over a synthetic 10 ms RTT,
+  serial (window=1) vs windowed (window=4) wall time.
+
+Run: ``python benchmarks/recovery_bench.py [--json PATH] [--quick]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+if os.environ.get("GPTPU_BENCH_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["GPTPU_BENCH_PLATFORM"])
+
+import numpy as np  # noqa: E402
+
+R = 3
+GROUPS = 8          # journaled services riding the huge plane
+GATE_SPEEDUP = 5.0
+
+
+def _mk_cfg(g: int):
+    from gigapaxos_tpu.config import GigapaxosTpuConfig
+
+    cfg = GigapaxosTpuConfig()
+    cfg.paxos.max_groups = g
+    cfg.paxos.window = 4
+    cfg.paxos.compact_outbox = True
+    cfg.paxos.exec_budget = 8192
+    return cfg
+
+
+def _drive(m, ticks: int) -> None:
+    """Traffic on GROUPS services for `ticks` journaled ticks (2-3 placed
+    proposals per service per tick — the busy-few / idle-many shape a
+    real restart replays)."""
+    for t in range(ticks):
+        for s in range(GROUPS):
+            for j in range(2 + (t + s) % 2):
+                m.propose(f"svc{s}", f"PUT k{t}.{j} v{s}.{t}.{j}".encode())
+        m.run_ticks(1)
+
+
+def _recover(cfg, workdir: str, mode: str):
+    from gigapaxos_tpu.models.replicable import KVApp
+    from gigapaxos_tpu.wal.logger import recover
+
+    t0 = time.monotonic()
+    m = recover(cfg, R, [KVApp() for _ in range(R)], workdir,
+                native=False, replay_mode=mode)
+    return m, time.monotonic() - t0
+
+
+def _serve_probe(m, services) -> float:
+    """Ticks until a probe PUT on every listed service is acked (executed
+    + fsynced — the ack rides the post-sync callback flush)."""
+    t0 = time.monotonic()
+    pending = set(services)
+
+    def mk_cb(s):
+        def cb(rid, resp):
+            pending.discard(s)
+        return cb
+
+    for s in services:
+        m.propose(s, b"PUT probe 1", callback=mk_cb(s))
+    for _ in range(64):
+        m.run_ticks(1)
+        if not pending:
+            break
+    assert not pending, f"probe never served: {pending}"
+    return time.monotonic() - t0
+
+
+def bench_recovery(g: int, ticks: int) -> dict:
+    """One plane size: journal a workload, crash, recover through both
+    arms, then measure service-restoration latency on the batched arm."""
+    from gigapaxos_tpu.models.replicable import KVApp
+    from gigapaxos_tpu.paxos.manager import PaxosManager
+    from gigapaxos_tpu.wal.logger import PaxosLogger
+
+    cfg = _mk_cfg(g)
+    root = tempfile.mkdtemp(prefix="recovery_bench_")
+    try:
+        live = os.path.join(root, "live")
+        wal = PaxosLogger(live, native=False)
+        m = PaxosManager(cfg, R, [KVApp() for _ in range(R)], wal=wal)
+        for s in range(GROUPS):
+            m.create_paxos_instance(f"svc{s}", [0, 1, 2])
+        t0 = time.monotonic()
+        _drive(m, ticks)
+        t_live = time.monotonic() - t0
+        m.wal.close()  # crash: no checkpoint, the journal is the state
+        jbytes = sum(os.path.getsize(p) for p in
+                     glob.glob(os.path.join(live, "journal.*.log")))
+        del m, wal
+
+        copy = os.path.join(root, "copy")
+        shutil.copytree(live, copy)
+        m_ref, t_ref = _recover(cfg, live, "reference")
+        ref_state = m_ref.state
+        ref_meta = (m_ref.tick_num, m_ref._next_rid,
+                    m_ref._host_exec.copy(),
+                    [dict(a.db) for a in m_ref.apps])
+        m_ref.wal.close()
+        del m_ref
+
+        crash_t0 = time.monotonic()
+        m_bat, t_bat = _recover(cfg, copy, "batched")
+        identical = all(
+            np.array_equal(np.asarray(getattr(ref_state, f)),
+                           np.asarray(getattr(m_bat.state, f)))
+            for f in ref_state._fields)
+        identical = (identical
+                     and ref_meta[0] == m_bat.tick_num
+                     and ref_meta[1] == m_bat._next_rid
+                     and np.array_equal(ref_meta[2], m_bat._host_exec)
+                     and all(ref_meta[3][r] == m_bat.apps[r].db
+                             for r in range(R)))
+        del ref_state, ref_meta
+        t_first = t_bat + _serve_probe(m_bat, ["svc0"])
+        _serve_probe(m_bat, [f"svc{s}" for s in range(GROUPS)])
+        t_full = time.monotonic() - crash_t0
+        out = {
+            "groups": g,
+            "ticks": ticks,
+            "journal_bytes": jbytes,
+            "t_live_s": round(t_live, 2),
+            "t_ref_replay_s": round(t_ref, 2),
+            "t_batched_replay_s": round(t_bat, 2),
+            "speedup": round(t_ref / t_bat, 2),
+            "bit_identical": bool(identical),
+            "replay_windows": m_bat._replay_windows,
+            "sparse_windows": m_bat._replay_sparse_windows,
+            "t_first_served_s": round(t_first, 2),
+            "t_full_service_s": round(t_full, 2),
+        }
+        m_bat.wal.close()
+        del m_bat
+        return out
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def bench_peer_stream(rtt_s: float = 0.01) -> dict:
+    """Parallel peer snapshot streaming: Mode B recovery pulling fresh
+    checkpoint blobs from two donors whose fetch path carries a
+    synthetic RTT — windowed streaming overlaps the waits."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests"))
+    from test_modeb import IDS, Cluster, make_cfg
+
+    from gigapaxos_tpu.models.replicable import KVApp
+    from gigapaxos_tpu.modeb import PeerCheckpointStreamer, recover_modeb
+
+    cfg = make_cfg()
+    out = {"rtt_ms": rtt_s * 1e3}
+    for label, window in (("serial", 1), ("window4", 4)):
+        root = tempfile.mkdtemp(prefix="recovery_bench_ps_")
+        try:
+            cl = Cluster(cfg, wal_root=__import__("pathlib").Path(root))
+            try:
+                for s in range(GROUPS):
+                    cl.create(f"svc{s}")
+                for i in range(4):
+                    for s in range(GROUPS):
+                        cl.commit(IDS[0], f"svc{s}",
+                                  f"PUT k{i} v{i}".encode())
+                victim = IDS[2]
+                cl.kill(victim)
+                cl.drop_backlog(victim)
+                for s in range(GROUPS):
+                    cl.commit(IDS[0], f"svc{s}", b"PUT gap 1",
+                              only=set(IDS[:2]))
+
+                def slow(fn):
+                    def wrapped(*a, **kw):
+                        time.sleep(rtt_s)
+                        return fn(*a, **kw)
+                    return wrapped
+
+                ps = PeerCheckpointStreamer(
+                    {nid: slow(cl.nodes[nid].donate_ckpt)
+                     for nid in IDS[:2]}, window=window)
+                cl.apps[victim] = KVApp()
+                t0 = time.monotonic()
+                node = recover_modeb(
+                    cfg, IDS, victim, cl.apps[victim],
+                    os.path.join(root, victim), native=False,
+                    peer_stream=ps)
+                out[f"t_{label}_s"] = round(time.monotonic() - t0, 3)
+                out[f"fetched_{label}"] = ps.stats["fetched"]
+                assert ps.stats["failed"] == 0
+                cl.nodes[victim] = node
+            finally:
+                cl.close()
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+    out["speedup"] = round(out["t_serial_s"] / out["t_window4_s"], 2)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "results_recovery_pr19.json"))
+    ap.add_argument("--quick", action="store_true",
+                    help="64k/256k only, fewer ticks")
+    ap.add_argument("--ticks", type=int, default=16)
+    args = ap.parse_args()
+
+    sizes = [65536, 262144] if args.quick else [65536, 262144, 1048576]
+    ticks = min(args.ticks, 8) if args.quick else args.ticks
+    res = {"bench": "recovery_pr19", "platform": jax.default_backend(),
+           "sizes": []}
+    for g in sizes:
+        r = bench_recovery(g, ticks)
+        res["sizes"].append(r)
+        print(json.dumps(r), flush=True)
+    res["peer_stream"] = bench_peer_stream()
+    print(json.dumps(res["peer_stream"]), flush=True)
+
+    top = res["sizes"][-1]
+    res["gate"] = {
+        "target_speedup": GATE_SPEEDUP,
+        "at_groups": top["groups"],
+        "speedup": top["speedup"],
+        "bit_identical_all": all(s["bit_identical"]
+                                 for s in res["sizes"]),
+        "pass": bool(top["speedup"] >= GATE_SPEEDUP
+                     and all(s["bit_identical"] for s in res["sizes"])),
+    }
+    with open(args.json, "w") as f:
+        json.dump(res, f, indent=2)
+    print(json.dumps({"bench": "recovery_pr19", "gate": res["gate"]}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

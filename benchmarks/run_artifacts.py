@@ -430,6 +430,30 @@ def bench_health() -> dict:
     return r
 
 
+def bench_recovery() -> dict:
+    """Fast-restart gate (benchmarks/recovery_bench.py): refreshes
+    results_recovery_pr19.json — crash-recovery time vs G (64k/256k/1M),
+    batched (sparse) replay vs the record-at-a-time reference arm.  Hard
+    gates: batched >= 5x at the largest plane, bit-identical recovered
+    state at every size."""
+    r = _script(["benchmarks/recovery_bench.py", "--json",
+                 "benchmarks/results_recovery_pr19.json"],
+                timeout=3600)[-1]
+    g = r["gate"]
+    if not g["pass"]:
+        raise RuntimeError(
+            f"recovery gate failed: {g['speedup']}x < "
+            f"{g['target_speedup']}x at {g['at_groups']} groups "
+            f"(bit_identical_all={g['bit_identical_all']})")
+    return {
+        "metric": "recovery_replay_speedup_at_1m_groups",
+        "value": g["speedup"],
+        "unit": "x_vs_record_at_a_time",
+        "bit_identical_all": g["bit_identical_all"],
+        "artifact": "benchmarks/results_recovery_pr19.json",
+    }
+
+
 def bench_cells_capacity() -> dict:
     """Serving-cells capacity sweep (benchmarks/cells_capacity.py):
     refreshes results_capacity_cells_pr8.json (1 -> 2 -> 4 cells with
@@ -521,6 +545,8 @@ def main() -> None:
     run("reads", bench_reads)
     # health plane (PR 18): in-tick group-health fold overhead gate
     run("health", bench_health)
+    # fast restart (PR 19): columnar/sparse replay recovery-time gate
+    run("recovery", bench_recovery)
 
     out = args.out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),

@@ -464,10 +464,36 @@ class CellSupervisor:
         return self.trace(tid)
 
     # -------------------------------------------------- health plane (ISSUE 18)
+    def _replay_sidecar(self, k: int) -> Optional[dict]:
+        """A cell mid-WAL-replay is single-threaded inside recovery and
+        cannot answer the healthz RPC — but the replay publishes a
+        ``replay_progress.json`` sidecar next to its journals (ISSUE 19).
+        A fresh, unfinished sidecar distinguishes "long replay" from
+        "hung cell"."""
+        spec = self.specs.get(k)
+        if spec is None:
+            return None
+        best = None
+        for d in (spec.wal_dir, spec.rc_wal_dir):
+            try:
+                with open(os.path.join(d, "replay_progress.json")) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if doc.get("phase") == "done":
+                continue
+            if time.time() - float(doc.get("ts", 0)) > 15.0:
+                continue  # stale: a replay that died mid-flight
+            if best is None or doc.get("ts", 0) > best.get("ts", 0):
+                best = doc
+        return best
+
     def healthz(self) -> dict:
         """Host-level readiness: 200 only when every cell's current
         incarnation is up AND answers ok (not draining, WAL healthy) —
-        the body names the cell that isn't."""
+        the body names the cell that isn't.  A cell that is alive but
+        still replaying its WAL reports ``recovering`` with progress
+        read from the replay sidecar rather than a bare ``up: False``."""
         cells = {}
         ok = not self._stopping
         for k, h in sorted(self.cells.items()):
@@ -476,7 +502,15 @@ class CellSupervisor:
                 try:
                     doc.update(h.healthz(timeout=10))
                 except Exception:
-                    doc["up"] = False
+                    rep = self._replay_sidecar(k)
+                    if rep is not None:
+                        doc["recovering"] = True
+                        tot = max(1, int(rep.get("records_total", 0)))
+                        doc["wal_replay_progress"] = (
+                            int(rep.get("records_done", 0)) / tot)
+                        doc["replay"] = rep
+                    else:
+                        doc["up"] = False
             cells[str(k)] = doc
             if not (doc["up"] and doc.get("ok", False)):
                 ok = False

@@ -137,6 +137,15 @@ def main() -> None:
             sys.stdout.write(line + "\n")
             sys.stdout.flush()
 
+    # a restart against a WAL directory that already holds state replays
+    # before serving: note it so the timeline shows the recovery span and
+    # healthz can report how much history was rolled forward
+    def _has_state(d: str) -> bool:
+        return os.path.isdir(d) and any(
+            fn.startswith(("journal.", "snapshot.")) for fn in os.listdir(d))
+
+    recovering = _has_state(spec["wal_dir"]) or _has_state(spec["rc_wal_dir"])
+    recovery_t0 = time.time()
     try:
         cluster = InProcessCluster(
             cfg, KVApp,
@@ -148,6 +157,7 @@ def main() -> None:
     except Exception as e:  # startup must be observable, not a silent death
         emit(f"startup_failed {type(e).__name__}: {e}")
         sys.exit(1)
+    recovery_t1 = time.time()
 
     # other cells' endpoints + the supervisor: reachable for edge forwarding
     # and control pings, but NOT part of this cell's consensus topology
@@ -212,6 +222,14 @@ def main() -> None:
         node=f"c{cell}")
     timeline.start()
     timeline.annotate("boot", cell=cell, pid=os.getpid())
+    if recovering:
+        # the replay ran before the recorder existed; the annotations carry
+        # their own wall times so the span still renders correctly
+        rep = obs_registry().gauge("wal_replay_records_done").value
+        timeline.annotate("recovery_start", cell=cell, at=recovery_t0)
+        timeline.annotate("recovery_finish", cell=cell, at=recovery_t1,
+                          seconds=recovery_t1 - recovery_t0,
+                          records=int(rep))
     # readiness state for the healthz command (503 while draining or after
     # a sticky WAL failure — supervisors stop routing, diagnostics stay up)
     ready_state = {"draining": False}
@@ -226,6 +244,12 @@ def main() -> None:
             "tick": int(cluster.manager.tick_num),
             "draining": ready_state["draining"],
             "wal_failed": wal_failed,
+            # a worker answering this RPC is past replay by construction;
+            # mid-replay the supervisor reads the replay_progress.json
+            # sidecar instead and reports recovering=True for the cell
+            "recovering": False,
+            "wal_replay_progress": float(
+                obs_registry().gauge("wal_replay_progress").value),
         }
 
     # migrated-name directory for edge routing, updated by `override` lines

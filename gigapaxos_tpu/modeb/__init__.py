@@ -9,7 +9,7 @@ over the DCN transport — the reference's deployment shape
 
 from .kernel import node_tick, node_tick_impl
 from .logger import ModeBLogger, recover_modeb
-from .manager import ModeBNode, rid_origin
+from .manager import ModeBNode, PeerCheckpointStreamer, rid_origin
 from .wire import decode_frame, encode_frame, gid_of
 
 __all__ = [
@@ -17,6 +17,7 @@ __all__ = [
     "ModeBNode",
     "ModeBReplicaCoordinator",
     "ModeBRepliconfigurableDB",
+    "PeerCheckpointStreamer",
     "decode_frame",
     "encode_frame",
     "gid_of",

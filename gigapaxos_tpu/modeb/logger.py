@@ -89,7 +89,7 @@ def replay_node_journals(node, log_dir, start_seq, stage, new_buffers,
     anywhere else they are corruption, not a crash artifact."""
     import collections
 
-    from ..wal.journal import scan_journal
+    from ..wal.journal import iter_scan_records, scan_journal
     from .common import RID_MASK, rid_origin
 
     corrupt_c = _obs_registry().counter(
@@ -205,14 +205,19 @@ def replay_node_journals(node, log_dir, start_seq, stage, new_buffers,
         if seq < start_seq:
             continue
         newest = path == paths[-1]
-        scan = scan_journal(path)
+        # bounded-memory scan first (classification without materializing
+        # payload copies); only the rare corrupt path re-scans collecting,
+        # because salvage needs the intact suffix in memory
+        scan = scan_journal(path, meta_only=True)
+        if scan.kind != "clean":
+            scan = scan_journal(path)
         # a tear is only innocent in the newest journal (the one being
         # appended at crash time); rolled journals were sealed by their
         # closing fsync, so missing bytes there are lost fsynced data
         bad = scan.kind == "scribble" or (
             scan.kind == "torn_tail" and not newest
             and scan.good_len < scan.file_size)
-        for idx, raw in enumerate(scan.records):
+        for idx, raw in enumerate(iter_scan_records(path, scan)):
             try:
                 rec = _load_op(raw, MODEB_OP_SCHEMA)
             except (ValueError, IndexError) as e:
@@ -241,7 +246,7 @@ def replay_node_journals(node, log_dir, start_seq, stage, new_buffers,
                 except (ValueError, IndexError):
                     corrupt_c.inc()
                     continue
-                dispatch(rec, len(scan.records), scan, False)
+                dispatch(rec, scan.n_records, scan, False)
     return degraded
 
 
@@ -349,7 +354,7 @@ class ModeBLogger(PaxosLogger):
 
 def recover_modeb(cfg, member_ids, node_id, app, log_dir: str,
                   native: bool = True, spill_ns=None,
-                  allow_degraded: bool = True):
+                  allow_degraded: bool = True, peer_stream=None):
     """Rebuild a ModeBNode from its own disk; attach a messenger and call
     ``request_sync()`` afterwards to rejoin the replica set.
 
@@ -358,7 +363,15 @@ def recover_modeb(cfg, member_ids, node_id, app, log_dir: str,
     ``allow_degraded`` — every own row is tainted so the laggard-repair
     machinery re-fetches authoritative state via checkpoint transfer;
     otherwise recovery fail-stops with :class:`WalQuarantinedError`
-    rather than silently serve a truncated log."""
+    rather than silently serve a truncated log.
+
+    ``peer_stream`` (a :class:`~gigapaxos_tpu.modeb.manager.
+    PeerCheckpointStreamer`) overlaps peer checkpoint fetches with the
+    local journal replay (ISSUE 19): the fetch plan — every own row known
+    at recovery start — is launched before replay, and the blobs are
+    adopted afterwards through the watermark-checked transfer path, so a
+    behind node reaches full service in max(replay, stream) instead of
+    replay + serial repair."""
     import collections
 
     import jax.numpy as jnp
@@ -437,6 +450,13 @@ def recover_modeb(cfg, member_ids, node_id, app, log_dir: str,
         for name, blob in (meta["app"] or {}).items():
             node.app.restore(name, blob)
         start_seq = snap_seq
+
+    if peer_stream is not None:
+        # launch the fetch plan NOW — every own row known at recovery
+        # start — so peer transfers stream while the journal replays;
+        # rows created later in the journal were born on this node and
+        # need no repair
+        peer_stream.start(wire.gid_of(name) for name in node.rows.names())
 
     def new_buffers():
         return (np.zeros((node.R, node.P, node.G), np.int32),
@@ -524,5 +544,11 @@ def recover_modeb(cfg, member_ids, node_id, app, log_dir: str,
         # completes must come back still-tainted, not trusting stale state
         for name in list(node.rows.names()):
             logger.log_taint(name)
+    if peer_stream is not None:
+        # adopt the streamed blobs through the watermark-checked transfer
+        # path: anything replay caught up past is dropped as stale, and a
+        # degraded node's blanket taint clears row by row as authoritative
+        # peer state lands
+        peer_stream.apply(node)
     node._force_full = True  # re-announce our row to peers on rejoin
     return node

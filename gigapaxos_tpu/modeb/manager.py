@@ -1880,8 +1880,11 @@ class ModeBNode(ModeBCommon):
             })
             self.stats["ckpt_requests"] += 1
 
-    def _on_ckpt_req(self, sender: str, p: dict) -> None:
-        gid = int(p["gid"])
+    def donate_ckpt(self, gid: int) -> Optional[dict]:
+        """Build a checkpoint-transfer packet for one of our rows, or None
+        if this replica must not donate.  Shared by the async
+        ``MB_CKPT_REQ`` handler and the recovery-time
+        :class:`PeerCheckpointStreamer` (synchronous fetch)."""
         with self.lock:
             # the donated (watermark, blob) pair must be consistent: with a
             # pipelined tick in flight the device exec watermark is ahead
@@ -1892,22 +1895,26 @@ class ModeBNode(ModeBCommon):
             self.drain_pipeline()
             row = self._gid_row.get(gid)
             if row is None or row in self._tainted_rows:
-                return  # never donate a diverged copy
+                return None  # never donate a diverged copy
             if row in self._stalled:
                 # a stalled row's app state EXCLUDES its stalled slots while
                 # its exec watermark includes them — donating would make the
                 # receiver skip those slots forever; let a caught-up peer
                 # donate instead (or this row after its stall drains)
-                return
+                return None
             name = self.rows.name(row)
             blob = self.app.checkpoint(name)
-            reply = {
+            return {
                 "type": MB_CKPT, "gid": str(gid),
                 "exec_slot": int(self.state.exec_slot[self.r, row]),
                 "status": int(self.state.status[self.r, row]),
                 "state": blob.hex(),
             }
-        self.m.send(sender, reply)
+
+    def _on_ckpt_req(self, sender: str, p: dict) -> None:
+        reply = self.donate_ckpt(int(p["gid"]))
+        if reply is not None:
+            self.m.send(sender, reply)
 
     def _on_ckpt(self, sender: str, p: dict) -> None:
         gid = int(p["gid"])
@@ -1982,3 +1989,124 @@ class ModeBNode(ModeBCommon):
     def close(self) -> None:
         if self.m is not None:
             self.m.close()
+
+
+class PeerCheckpointStreamer:
+    """Parallel peer snapshot streaming for recovery (ISSUE 19).
+
+    PR 10's anti-entropy repair fetched peer checkpoints one row at a
+    time, *after* local WAL replay finished — so time-to-full-service was
+    replay + N sequential transfers.  This streamer overlaps the two:
+    recovery hands it the fetch plan (the recovering node's own group
+    ids) *before* replay starts, worker threads pull checkpoint packets
+    from multiple donors concurrently while the replay loop runs, and
+    the blobs are adopted after replay through the same watermark-checked
+    ``_apply_ckpt`` path as a live transfer — a blob that replay already
+    caught up past is simply dropped as stale, so overlap can never
+    regress state.
+
+    ``fetchers`` maps donor id -> ``callable(gid) -> packet | None``
+    where the packet is ``MB_CKPT``-shaped (``exec_slot`` / ``status`` /
+    ``state``); :meth:`ModeBNode.donate_ckpt` is the canonical donor-side
+    producer (in-process planes and tests call it directly; an RPC
+    deployment wraps its transport equivalent).  Donors are interleaved
+    round-robin across the plan and failed fetches rotate to the next
+    donor, so one slow or refusing peer neither serializes nor starves
+    the stream."""
+
+    def __init__(self, fetchers: Dict[str, Callable], window: int = 4):
+        import threading
+
+        self.fetchers = dict(fetchers)
+        self.window = max(1, int(window))
+        self._results: list = []
+        self._threads: list = []
+        self._lock = threading.Lock()
+        self._queue = None
+        self._planned: set = set()
+        self.stats = {"fetched": 0, "failed": 0, "applied": 0, "stale": 0}
+
+    def start(self, gids) -> None:
+        """Begin fetching (non-blocking).  ``gids`` is the initial fetch
+        plan — every own row known at recovery start (snapshot rows).
+        Rows that only materialize during journal replay (no checkpoint
+        yet) are picked up by :meth:`apply`, which extends the plan before
+        adopting."""
+        self._launch(gids)
+
+    def _launch(self, gids) -> None:
+        import queue
+        import threading
+
+        peers = sorted(self.fetchers)
+        gids = [int(g) for g in gids if int(g) not in self._planned]
+        if not peers or not gids:
+            return
+        self._planned.update(gids)
+        if self._queue is None:
+            self._queue = queue.Queue()
+        for i, gid in enumerate(gids):
+            self._queue.put((gid, i % len(peers)))
+        # workers exit when the queue drains, so each launch (re)spawns
+        # its own window of them
+        for _ in range(min(self.window, len(gids))):
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name="ckpt-stream")
+            t.start()
+            self._threads.append(t)
+
+    def _worker(self) -> None:
+        import queue
+
+        peers = sorted(self.fetchers)
+        while True:
+            try:
+                gid, pi = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            pkt = None
+            for off in range(len(peers)):  # rotate donors on failure
+                peer = peers[(pi + off) % len(peers)]
+                try:
+                    pkt = self.fetchers[peer](gid)
+                except Exception:
+                    pkt = None
+                if pkt is not None:
+                    break
+            with self._lock:
+                if pkt is not None:
+                    self.stats["fetched"] += 1
+                    self._results.append((gid, pkt))
+                else:
+                    self.stats["failed"] += 1
+
+    def join(self, timeout_s: Optional[float] = None) -> list:
+        for t in self._threads:
+            t.join(timeout_s)
+        with self._lock:
+            return list(self._results)
+
+    def apply(self, node) -> int:
+        """Adopt the fetched blobs (recovery thread, after replay and WAL
+        re-attach).  Mirrors the live ``_on_ckpt`` order — journal the
+        transfer, then apply through the watermark check — so a crash
+        mid-adoption replays to the same state."""
+        # rows born inside the journal (unknown at stream start — no
+        # checkpoint covered them yet) join the plan now: still a
+        # parallel multi-donor fetch, just without the replay overlap
+        self._launch(set(node._gid_row))
+        applied = 0
+        for gid, pkt in self.join():
+            row = node._gid_row.get(int(gid))
+            if row is None:
+                continue
+            before = node.stats["ckpt_transfers"]
+            if node.wal is not None:
+                node.wal.log_ckpt(int(gid), dict(pkt))
+            node._apply_ckpt(row, pkt)
+            if node.stats["ckpt_transfers"] > before:
+                applied += 1
+                self.stats["applied"] += 1
+            else:
+                self.stats["stale"] += 1
+        return applied

@@ -1599,6 +1599,173 @@ def merge_compact_outbox(co_l: CompactHostOutbox, co_r: CompactHostOutbox,
 
 
 # --------------------------------------------------------------------------
+# Batched WAL replay (ISSUE 19): lax.scan over the tick axis.
+#
+# Journal replay re-runs the SAME fused tick body as the live run, but the
+# record-at-a-time loop paid one host→device inbox upload, one device
+# dispatch and one device→host outbox pull PER journaled tick.  Here a
+# window of K tick inboxes arrives as padded COO columns (entry, lane,
+# row, rid, stop — see wal/columnar.py), the scan body scatters each
+# tick's dense inbox on device and runs the tick, and each tick emits the
+# budgeted compact outbox — so a window costs ONE dispatch and one
+# [K, total] pull, and the host processes the per-tick exec streams
+# through the vectorized compact fold.
+#
+# The scan programs deliberately do NOT donate their inputs: the host
+# keeps the pre-window state so a budget overflow (a tick whose true
+# n_exec exceeds the scatter budget — detectable from the compact header)
+# can discard the window's outputs and re-run it through the
+# record-at-a-time reference arm without any loss.
+# --------------------------------------------------------------------------
+
+
+def _coo_inbox(x, R: int, P: int, g_total: int) -> TickInbox:
+    """Scatter one tick's COO columns into the dense [R, P, G] inbox.
+    Padding lanes target row == g_total, one past the composite row
+    space, and fall out via mode="drop" — bit-identical to the host-side
+    dense buffers the reference arm builds."""
+    e, p, g = x["e"], x["p"], x["g"]
+    req = jnp.zeros((R, P, g_total), I32).at[e, p, g].set(
+        x["rid"], mode="drop")
+    stop = jnp.zeros((R, P, g_total), jnp.bool_).at[e, p, g].set(
+        x["stop"], mode="drop")
+    return TickInbox(req, stop, x["alive"])
+
+
+def _replay_scan_impl(state, xs, P: int, exec_budget: int,
+                      scat_budget: int, lag_budget: int):
+    R, G = state.exec_slot.shape
+
+    def body(st, x):
+        st, out = paxos_tick_impl(st, _coo_inbox(x, R, P, G), -1,
+                                  exec_budget)
+        return st, _compact_outbox_impl(out, scat_budget, lag_budget)
+
+    return jax.lax.scan(body, state, xs)
+
+
+#: K journaled ticks in one device program; returns (state, packs[K, total])
+replay_scan_ticks = jax.jit(_replay_scan_impl, static_argnums=(2, 3, 4, 5))
+
+
+def _replay_scan_lease_impl(state, lease, xs, P: int, exec_budget: int,
+                            scat_budget: int, lag_budget: int,
+                            lease_horizon: int):
+    R, G = state.exec_slot.shape
+    lp0 = jnp.zeros((5, G), I32)
+
+    def body(carry, x):
+        st, ls, _ = carry
+        st, out, ls, lp = paxos_tick_impl(
+            st, _coo_inbox(x, R, P, G), -1, exec_budget, lease=ls,
+            lease_horizon=lease_horizon)
+        packed = _compact_outbox_impl(out, scat_budget, lag_budget)
+        return (st, ls, lp), (packed, jnp.sum(lp[LP_WAIT]).astype(I32))
+
+    (state, lease, lp_last), (packs, waits) = jax.lax.scan(
+        body, (state, lease, lp0), xs)
+    return state, lease, packs, lp_last, waits
+
+
+#: lease twin: also returns the FINAL tick's lease pack (the host mirror
+#: only ever holds the latest pack) and per-tick wait sums for metrics
+replay_scan_ticks_lease = jax.jit(
+    _replay_scan_lease_impl, static_argnums=(3, 4, 5, 6, 7))
+
+
+def _replay_scan_mixed_impl(state, rstate, xs, P: int, exec_budget: int,
+                            scat_budget: int, lag_budget: int):
+    R, g_log = state.exec_slot.shape
+    g_total = g_log + rstate.exec_slot.shape[1]
+
+    def body(carry, x):
+        st, rst = carry
+        ib_l, ib_r = _split_inbox(_coo_inbox(x, R, P, g_total), g_log)
+        st, out_l = paxos_tick_impl(st, ib_l, -1, exec_budget)
+        rst, out_r = paxos_tick_impl(rst, ib_r, -1, exec_budget)
+        return (st, rst), jnp.concatenate([
+            _compact_outbox_impl(out_l, scat_budget, lag_budget),
+            _compact_outbox_impl(out_r, scat_budget, lag_budget),
+        ])
+
+    (state, rstate), packs = jax.lax.scan(body, (state, rstate), xs)
+    return state, rstate, packs
+
+
+#: mixed-plane twin: per tick the two planes' compact buffers ride one
+#: [total_l + total_r] row (host slices via CompactLayout per plane)
+replay_scan_ticks_mixed = jax.jit(
+    _replay_scan_mixed_impl, static_argnums=(3, 4, 5, 6))
+
+
+def _replay_scan_mixed_lease_impl(state, rstate, lease, rlease, xs, P: int,
+                                  exec_budget: int, scat_budget: int,
+                                  lag_budget: int, lease_horizon: int):
+    R, g_log = state.exec_slot.shape
+    g_reg = rstate.exec_slot.shape[1]
+    g_total = g_log + g_reg
+    lp0 = (jnp.zeros((5, g_log), I32), jnp.zeros((5, g_reg), I32))
+
+    def body(carry, x):
+        st, rst, ls, rls, _ = carry
+        ib_l, ib_r = _split_inbox(_coo_inbox(x, R, P, g_total), g_log)
+        st, out_l, ls, lp_l = paxos_tick_impl(
+            st, ib_l, -1, exec_budget, lease=ls,
+            lease_horizon=lease_horizon)
+        rst, out_r, rls, lp_r = paxos_tick_impl(
+            rst, ib_r, -1, exec_budget, lease=rls,
+            lease_horizon=lease_horizon)
+        packed = jnp.concatenate([
+            _compact_outbox_impl(out_l, scat_budget, lag_budget),
+            _compact_outbox_impl(out_r, scat_budget, lag_budget),
+        ])
+        waits = (jnp.sum(lp_l[LP_WAIT]) + jnp.sum(lp_r[LP_WAIT])).astype(I32)
+        return (st, rst, ls, rls, (lp_l, lp_r)), (packed, waits)
+
+    (state, rstate, lease, rlease, lp_last), (packs, waits) = jax.lax.scan(
+        body, (state, rstate, lease, rlease, lp0), xs)
+    return state, rstate, lease, rlease, packs, lp_last, waits
+
+
+replay_scan_ticks_mixed_lease = jax.jit(
+    _replay_scan_mixed_lease_impl, static_argnums=(5, 6, 7, 8, 9))
+
+
+# --------------------------------------------------------------------------
+# Sparse window replay (ISSUE 19): the tick fold is a pure per-group map —
+# a row whose inbox is empty does not change AT ALL across a tick (no tick
+# counter enters the fold, cross-replica reductions are row-local), so a
+# replay window only needs the rows its journaled inboxes actually touch.
+# The dispatcher gathers those rows into a narrow [R, .., A] plane (G is
+# the minor axis of every state field), runs the SAME scan programs above
+# at width A instead of G, and scatters the evolved columns back — per
+# journaled tick the device fold costs O(active), not O(G).  This is what
+# makes batched replay win at 1M groups: the dense scan still pays the
+# full-plane tick body per journaled tick, which at G=1M dwarfs the
+# dispatch overhead it saves.  The lease fold (per-tick countdown on every
+# row) and the health fold (per-tick heat decay) violate the idle-row
+# no-op and keep the dense scan path (wal/logger gates them out).
+# --------------------------------------------------------------------------
+
+
+def _gather_rows_impl(state, rows):
+    return jax.tree.map(lambda a: jnp.take(a, rows, axis=a.ndim - 1), state)
+
+
+#: columns `rows` of the G (minor) axis of every field, as a narrow state
+replay_gather_rows = jax.jit(_gather_rows_impl)
+
+
+def _scatter_rows_impl(full, compact, rows):
+    return jax.tree.map(
+        lambda f, c: f.at[..., rows].set(c), full, compact)
+
+
+#: inverse of :func:`replay_gather_rows`; `rows` must be duplicate-free
+replay_scatter_rows = jax.jit(_scatter_rows_impl)
+
+
+# --------------------------------------------------------------------------
 # Group-health plane (ISSUE 18): the host side of the health fold above —
 # the flat health_pack layout, its unpack, the composite-plane merge, and
 # the single generic health tick entry point that covers every dispatch

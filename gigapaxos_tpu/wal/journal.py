@@ -40,7 +40,9 @@ Two interchangeable backends:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import mmap
 import os
 import struct
 import zlib
@@ -70,8 +72,8 @@ class JournalCorruptError(RuntimeError):
         self.scan = scan
         super().__init__(
             f"journal {path}: {scan.kind} at byte {scan.bad_offset} "
-            f"({len(scan.records)} intact records before, "
-            f"{len(scan.suffix)} intact after"
+            f"({scan.n_records} intact records before, "
+            f"{scan.n_suffix} intact after"
             + (f", resync at byte {scan.resync_offset}"
                if scan.resync_offset is not None else "")
             + ") — fsynced (possibly client-acked) data was damaged; "
@@ -104,14 +106,30 @@ class JournalScan:
     resync_offset: Optional[int]     # where the intact suffix resumes
     last_seq: int                    # last intact-prefix frame seq (v2)
     file_size: int
+    # bounded-memory (meta_only) scans classify without materializing
+    # payload copies: ``records``/``suffix`` stay empty and only the counts
+    # below are filled.  For collecting scans they mirror the list lengths.
+    n_records: int = -1
+    n_suffix: int = -1
+
+    def __post_init__(self):
+        if self.n_records < 0:
+            self.n_records = len(self.records)
+        if self.n_suffix < 0:
+            self.n_suffix = len(self.suffix)
 
 
-def _parse_frames(buf: bytes, pos: int, version: int, last_seq: int):
+def _parse_frames(buf, pos: int, version: int, last_seq: int,
+                  collect: bool = True):
     """Parse frames from ``buf[pos:]`` until a bad one.  Returns
-    (payloads, n_synced, end_pos, last_seq).  For v2, frames must carry
-    strictly increasing seq — a CRC-valid frame with a bogus seq is not
-    part of this log's stream."""
+    (payloads, n_data, n_synced, end_pos, last_seq).  For v2, frames must
+    carry strictly increasing seq — a CRC-valid frame with a bogus seq is
+    not part of this log's stream.  ``buf`` may be bytes or a memoryview
+    over an mmap; with ``collect=False`` frames are validated and counted
+    without copying any payload bytes out of the map (the bounded-memory
+    scan — peak RSS stays O(1) no matter the journal size)."""
     payloads: List[bytes] = []
+    n_data = 0
     n_synced = 0
     end = len(buf)
     while pos + _HDR.size <= end:
@@ -129,18 +147,23 @@ def _parse_frames(buf: bytes, pos: int, version: int, last_seq: int):
                 break
             last_seq = seq
             if kind == KIND_BARRIER:
-                n_synced = len(payloads)
+                n_synced = n_data
             else:
-                payloads.append(body[_BODY.size:])
+                n_data += 1
+                if collect:
+                    payloads.append(bytes(body[_BODY.size:]))
         else:
-            payloads.append(body)
+            n_data += 1
+            if collect:
+                payloads.append(bytes(body))
         pos += _HDR.size + length
-    return payloads, n_synced, pos, last_seq
+    return payloads, n_data, n_synced, pos, last_seq
 
 
-def _resync(buf: bytes, gap_start: int, version: int, last_seq: int):
+def _resync(buf, gap_start: int, version: int, last_seq: int,
+            collect: bool = True):
     """Look for an intact frame stream after a corrupt gap.  Returns
-    (offset, payloads) or (None, [])."""
+    (offset, payloads, n_payloads) or (None, [], 0)."""
     end = len(buf)
     for off in range(gap_start + 1, end - _HDR.size + 1):
         length, crc = _HDR.unpack_from(buf, off)
@@ -157,53 +180,114 @@ def _resync(buf: bytes, gap_start: int, version: int, last_seq: int):
                 continue
             if not (last_seq < seq <= last_seq + SEQ_SLACK):
                 continue
-            payloads, _, _, _ = _parse_frames(buf, off, 2, seq - 1)
-            return off, payloads
+            payloads, n_data, _, _, _ = _parse_frames(
+                buf, off, 2, seq - 1, collect)
+            return off, payloads, n_data
         # v1 has no seq to validate against, so require the candidate
         # stream to parse cleanly all the way to EOF — a lone CRC
         # collision mid-garbage will not do that
-        payloads, _, stop, _ = _parse_frames(buf, off, 1, 0)
-        if payloads and stop == end:
-            return off, payloads
-    return None, []
+        payloads, n_data, _, stop, _ = _parse_frames(buf, off, 1, 0, collect)
+        if n_data and stop == end:
+            return off, payloads, n_data
+    return None, [], 0
 
 
-def scan_journal(path: str) -> JournalScan:
+@contextlib.contextmanager
+def _map_journal(path: str):
+    """Yield a read-only memoryview over the file (empty bytes for an
+    empty file).  Slicing the view copies only the bytes touched, so a
+    multi-GB journal is scanned through the page cache in fixed-size
+    windows instead of being materialized whole."""
+    with open(path, "rb") as f:
+        size = os.fstat(f.fileno()).st_size
+        if size == 0:
+            yield memoryview(b"")
+            return
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        mv = memoryview(mm)
+        try:
+            yield mv
+        finally:
+            mv.release()
+            mm.close()
+
+
+def scan_journal(path: str, meta_only: bool = False) -> JournalScan:
     """Classify a journal file: clean / torn tail / scribble (see
     :class:`JournalScan`).  This is the read-side authority both backends
-    defer to before opening an existing file for append."""
-    with open(path, "rb") as f:
-        buf = f.read()
-    size = len(buf)
-    if size < len(MAGIC2):
-        # shorter than a magic: a tear during file creation — nothing in
-        # it was ever fsync-acked (the magic write precedes any record)
-        return JournalScan(2 if not buf else 0, "torn_tail", [], 0, [],
-                           0, 0, None, 0, size)
-    magic = buf[:len(MAGIC2)]
-    if magic == MAGIC2:
-        version = 2
-    elif magic == MAGIC:
-        version = 1
-    else:
-        # non-empty file with damaged magic: a scribble over the header —
-        # every record in the file is unreachable but possibly acked
-        return JournalScan(0, "scribble", [], 0, [], 0, 0, None, 0, size)
-    payloads, n_synced, good, last_seq = _parse_frames(
-        buf, len(MAGIC2), version, 0)
-    if version == 1:
-        # no barriers in v1: conservatively treat every intact record as
-        # potentially acked (fail closed on decode errors during replay)
-        n_synced = len(payloads)
-    if good == size:
-        return JournalScan(version, "clean", payloads, n_synced, [],
-                           good, good, None, last_seq, size)
-    resync_off, suffix = _resync(buf, good, version, last_seq)
-    if resync_off is not None:
-        return JournalScan(version, "scribble", payloads, n_synced, suffix,
-                           good, good, resync_off, last_seq, size)
-    return JournalScan(version, "torn_tail", payloads, n_synced, [],
-                       good, good, None, last_seq, size)
+    defer to before opening an existing file for append.
+
+    ``meta_only=True`` runs the identical classification (byte-for-byte
+    the same verdicts) but leaves ``records``/``suffix`` empty, filling
+    only the counts — pair with :func:`iter_scan_records` to replay a
+    journal without ever holding more than one record in memory."""
+    collect = not meta_only
+    with _map_journal(path) as buf:
+        size = len(buf)
+        if size < len(MAGIC2):
+            # shorter than a magic: a tear during file creation — nothing
+            # in it was ever fsync-acked (the magic write precedes any
+            # record)
+            return JournalScan(2 if not size else 0, "torn_tail", [], 0,
+                               [], 0, 0, None, 0, size, 0, 0)
+        magic = bytes(buf[:len(MAGIC2)])
+        if magic == MAGIC2:
+            version = 2
+        elif magic == MAGIC:
+            version = 1
+        else:
+            # non-empty file with damaged magic: a scribble over the
+            # header — every record in the file is unreachable but
+            # possibly acked
+            return JournalScan(0, "scribble", [], 0, [], 0, 0, None, 0,
+                               size, 0, 0)
+        payloads, n_data, n_synced, good, last_seq = _parse_frames(
+            buf, len(MAGIC2), version, 0, collect)
+        if version == 1:
+            # no barriers in v1: conservatively treat every intact record
+            # as potentially acked (fail closed on decode errors during
+            # replay)
+            n_synced = n_data
+        if good == size:
+            return JournalScan(version, "clean", payloads, n_synced, [],
+                               good, good, None, last_seq, size, n_data, 0)
+        resync_off, suffix, n_suffix = _resync(buf, good, version, last_seq,
+                                               collect)
+        if resync_off is not None:
+            return JournalScan(version, "scribble", payloads, n_synced,
+                               suffix, good, good, resync_off, last_seq,
+                               size, n_data, n_suffix)
+        return JournalScan(version, "torn_tail", payloads, n_synced, [],
+                           good, good, None, last_seq, size, n_data, 0)
+
+
+def iter_scan_records(path: str, scan: JournalScan) -> Iterator[bytes]:
+    """Stream the intact-prefix DATA payloads of a scanned journal one
+    record at a time (the bounded-memory replay reader).  Yields exactly
+    ``scan.n_records`` items, byte-identical to ``scan.records`` from a
+    collecting scan; frames were already CRC-validated by the scan, so
+    the walk just re-frames up to ``good_len``."""
+    if scan.records:
+        yield from scan.records
+        return
+    if scan.n_records == 0:
+        return
+    with _map_journal(path) as buf:
+        pos = len(MAGIC2)
+        end = scan.good_len
+        # only TEMPORARY slices of the map below: a named slice would
+        # still be alive in this frame when the contextmanager unmaps,
+        # and mmap.close() refuses while exported buffers exist
+        while pos + _HDR.size <= end:
+            length, _ = _HDR.unpack_from(buf, pos)
+            o = pos + _HDR.size
+            if scan.version == 2:
+                kind, _ = _BODY.unpack_from(buf, o)
+                if kind == KIND_DATA:
+                    yield bytes(buf[o + _BODY.size:o + length])
+            else:
+                yield bytes(buf[o:o + length])
+            pos += _HDR.size + length
 
 
 def _valid_length(path: str) -> int:

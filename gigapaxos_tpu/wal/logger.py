@@ -37,7 +37,7 @@ from typing import List, Optional
 import numpy as np
 
 from . import records
-from .journal import JournalCorruptError, scan_journal
+from .journal import JournalCorruptError, iter_scan_records, scan_journal
 from ..obs.metrics import registry as _obs_registry
 from ..paxos.paystore import DEDUP_MIN_BYTES, payload_digest
 from ..paxos.state import PaxosState
@@ -795,13 +795,17 @@ def _load_op(raw: bytes, schema):
     return rec
 
 
-def _scan_for_replay(path: str, newest: bool):
+def _scan_for_replay(path: str, newest: bool, meta_only: bool = False):
     """Scan a journal for replay; scribbles fail-stop here (Mode A and
     chain WALs have no peer copy, so the intact suffix is unrecoverable
     locally — the one honest option is to refuse, loudly, with the file
     left in place as evidence).  Mode B overrides this policy in
-    modeb/logger.py with quarantine + taint + peer repair."""
-    scan = scan_journal(path)
+    modeb/logger.py with quarantine + taint + peer repair.
+
+    ``meta_only=True`` classifies without materializing record payloads
+    (identical verdicts); pair with ``iter_scan_records`` to stream the
+    records in bounded memory."""
+    scan = scan_journal(path, meta_only=meta_only)
     if scan.kind == "scribble":
         _obs_registry().counter(
             "wal_corrupt_records_total",
@@ -809,7 +813,7 @@ def _scan_for_replay(path: str, newest: bool):
         ).inc()
         raise WalQuarantinedError(
             f"journal {path}: mid-log corruption at byte "
-            f"{scan.bad_offset} with {len(scan.suffix)} intact records "
+            f"{scan.bad_offset} with {scan.n_suffix} intact records "
             "after it — fsynced (possibly acked) data was damaged and "
             "this WAL has no peer copy to repair from; refusing to "
             "silently truncate.  The file is left in place; inspect or "
@@ -897,8 +901,177 @@ def _resolve_tick_payrefs(rec, pay_tab: dict):
     return tuple(lst)
 
 
+class ReplayProgress:
+    """Recovery progress accounting + publication (ISSUE 19 satellite).
+
+    Tracks records/bytes replayed vs. the scanned total, exposes them as
+    ``wal_replay_*`` gauges, and (when ``log_dir`` is given) publishes a
+    sidecar ``replay_progress.json`` next to the journals.  The sidecar
+    matters because a cell replaying its WAL is single-threaded inside
+    recovery and cannot answer a /healthz RPC — the supervisor reads the
+    file instead, so a long replay is distinguishable from a hung cell."""
+
+    SIDE_FILE = "replay_progress.json"
+
+    def __init__(self, log_dir: Optional[str] = None,
+                 min_interval_s: float = 0.25):
+        self.log_dir = log_dir
+        self.records_total = 0
+        self.records_done = 0
+        self.bytes_total = 0
+        self.bytes_done = 0
+        self._file_records = 1
+        self._file_recs_done = 0
+        self._file_bytes = 0
+        self._file_done = 0
+        self.phase = "scan"
+        self._min_interval = min_interval_s
+        self._last_pub = 0.0
+        reg = _obs_registry()
+        self._g_frac = reg.gauge(
+            "wal_replay_progress",
+            help="WAL replay progress: records replayed / records scanned")
+        self._g_done = reg.gauge(
+            "wal_replay_records_done", help="journal records replayed")
+        self._g_total = reg.gauge(
+            "wal_replay_records_total", help="journal records scanned")
+
+    def begin(self, paths: List[str]) -> None:
+        self.phase = "replay"
+        self.bytes_total = sum(
+            os.path.getsize(p) for p in paths if os.path.exists(p))
+        self._publish(force=True)
+
+    def file_scanned(self, path: str, scan) -> None:
+        """A journal finished scanning: its record count joins the total
+        and per-record byte sizes are approximated pro rata."""
+        self.bytes_done += self._file_bytes - self._file_done
+        self.records_total += scan.n_records
+        self._file_records = max(1, scan.n_records)
+        self._file_recs_done = 0
+        self._file_bytes = scan.file_size
+        self._file_done = 0
+        self._publish(force=True)
+
+    def advance(self, n_records: int = 1) -> None:
+        self.records_done += n_records
+        self._file_recs_done += n_records
+        done = int(self._file_bytes
+                   * min(1.0, self._file_recs_done / self._file_records))
+        if done > self._file_done:
+            self.bytes_done += done - self._file_done
+            self._file_done = done
+        self._publish()
+
+    def finish(self) -> None:
+        self.phase = "done"
+        self.bytes_done += self._file_bytes - self._file_done
+        self._file_done = self._file_bytes
+        self._publish(force=True)
+
+    def snapshot(self) -> dict:
+        return {
+            "phase": self.phase,
+            "records_done": int(self.records_done),
+            "records_total": int(self.records_total),
+            "bytes_done": int(self.bytes_done),
+            "bytes_total": int(self.bytes_total),
+            "ts": time.time(),
+        }
+
+    def _publish(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_pub < self._min_interval:
+            return
+        self._last_pub = now
+        tot = max(1, self.records_total)
+        self._g_frac.set(self.records_done / tot)
+        self._g_done.set(self.records_done)
+        self._g_total.set(self.records_total)
+        if self.log_dir is None:
+            return
+        import json
+
+        path = os.path.join(self.log_dir, self.SIDE_FILE)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(self.snapshot(), f)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # progress publication must never fail a recovery
+
+
+def _stage_placed(m, placed, make_record, on_place=None):
+    """Per-tick host staging shared by BOTH replay arms: rid-counter
+    repair, outstanding-record creation, snapshot-queue dedup (a request
+    queued in the snapshot and placed in the journal would commit twice),
+    and the ``m._placed`` take-list the outbox fold re-queues rejects
+    from.  ``on_place`` (reference arm only) scatters into the dense host
+    inbox buffers; the batched arm ships COO columns instead."""
+    import collections
+
+    m._placed = []
+    for row, entries in placed:
+        take = []
+        placed_rids = set()
+        for rid, entry, p, payload, stop in entries:
+            m._next_rid = max(m._next_rid, rid + 1)
+            placed_rids.add(rid)
+            if rid not in m.outstanding:
+                m.outstanding[rid] = make_record(
+                    m, rid, row, payload, stop, entry
+                )
+            if on_place is not None:
+                on_place(entry, p, row, rid, stop)
+            take.append((rid, entry, p))
+        m._placed.append((row, take))
+        if row in m._queues and placed_rids:
+            m._queues[row] = collections.deque(
+                r for r in m._queues[row] if r not in placed_rids
+            )
+    return m._placed
+
+
+def _replay_admin_op(m, rec) -> None:
+    """Re-apply one journaled admin op (everything except OP_TICK/OP_REG)
+    — shared by both replay arms; in the batched arm these are the batch
+    barriers, because they mutate rows/state outside the tick body."""
+    op = rec[0]
+    if op == OP_CREATE:
+        _, name, members, epoch = rec[:4]
+        register = bool(rec[4]) if len(rec) > 4 else False
+        if name not in m.rows:
+            if register:
+                m.create_paxos_instance(name, members, epoch,
+                                        register=True)
+            else:
+                m.create_paxos_instance(name, members, epoch)
+    elif op == OP_CREATE_AT:
+        _, name, members, epoch, row, app_seed = rec
+        if name not in m.rows:
+            # targeted create + app re-seed: replay lands the migrated
+            # group on the SAME row with the SAME state
+            m.create_paxos_instance_at(
+                name, members, epoch, row, app_seed=app_seed
+            )
+    elif op == OP_REMOVE:
+        m.remove_paxos_instance(rec[1])
+    elif op == OP_PAUSE:
+        m._do_pause([n for n in rec[1] if n in m.rows])
+    elif op == OP_UNPAUSE:
+        m._unpause(rec[1])
+    elif op == OP_SYNC:
+        if len(rec) >= 7:  # exact record: apply verbatim
+            _, r, name, _donor, d_exec, d_status, ckpt = rec[:7]
+            m.apply_sync(r, name, d_exec, d_status, ckpt)
+        else:  # legacy donor-only record (pre-round-5 journals)
+            _, r, name, donor = rec
+            m.sync_laggard(r, name, donor=donor)
+
+
 def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
-                    build_inbox, tick_fn, bulk_replay=None):
+                    build_inbox, tick_fn, bulk_replay=None, progress=None):
     """Shared journal-replay loop (passes 2–3 of recovery) for any manager.
 
     The protocol-specific parts are injected: ``make_record`` builds the
@@ -908,9 +1081,12 @@ def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
     against snapshot queues (without which a request queued in the snapshot
     and placed in the journal would commit twice), rid-counter repair — is
     identical across protocols and lives here once.
-    """
-    import collections
 
+    This is the record-at-a-time REFERENCE arm: one device dispatch per
+    journaled tick.  ``replay_journals_batched`` is the columnar fast
+    arm; bit-identity between the two is asserted by
+    tests/test_replay_batched.py.
+    """
     # payref resolution table: each journal is a self-contained dedup epoch
     # (writer resets _pay_seen at every roll), so an empty table fills in
     # from raw bodies as records — including snapshot-skipped ticks — decode
@@ -919,13 +1095,21 @@ def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
     # writer appends them immediately before it, same tick_num)
     pending_reg = None
     paths = sorted(glob.glob(os.path.join(log_dir, "journal.*.log")))
+    if progress is not None:
+        progress.begin([p for p in paths
+                        if int(os.path.basename(p).split(".")[1])
+                        >= start_seq])
     for path in paths:
         seq = int(os.path.basename(path).split(".")[1])
         if seq < start_seq:
             continue
         newest = path == paths[-1]
-        scan = _scan_for_replay(path, newest)
-        for idx, raw in enumerate(scan.records):
+        scan = _scan_for_replay(path, newest, meta_only=True)
+        if progress is not None:
+            progress.file_scanned(path, scan)
+        for idx, raw in enumerate(iter_scan_records(path, scan)):
+            if progress is not None:
+                progress.advance()
             try:
                 rec = _load_op(raw, OP_SCHEMA)
                 if rec[0] == OP_TICK:
@@ -939,39 +1123,11 @@ def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
                 if _tolerate_or_raise(path, idx, scan, newest, e):
                     break
             op = rec[0]
-            if op == OP_CREATE:
-                _, name, members, epoch = rec[:4]
-                register = bool(rec[4]) if len(rec) > 4 else False
-                if name not in m.rows:
-                    if register:
-                        m.create_paxos_instance(name, members, epoch,
-                                                register=True)
-                    else:
-                        m.create_paxos_instance(name, members, epoch)
-            elif op == OP_CREATE_AT:
-                _, name, members, epoch, row, app_seed = rec
-                if name not in m.rows:
-                    # targeted create + app re-seed: replay lands the
-                    # migrated group on the SAME row with the SAME state
-                    m.create_paxos_instance_at(
-                        name, members, epoch, row, app_seed=app_seed
-                    )
-            elif op == OP_REMOVE:
-                m.remove_paxos_instance(rec[1])
-            elif op == OP_PAUSE:
-                m._do_pause([n for n in rec[1] if n in m.rows])
-            elif op == OP_UNPAUSE:
-                m._unpause(rec[1])
-            elif op == OP_SYNC:
-                if len(rec) >= 7:  # exact record: apply verbatim
-                    _, r, name, _donor, d_exec, d_status, ckpt = rec[:7]
-                    m.apply_sync(r, name, d_exec, d_status, ckpt)
-                else:  # legacy donor-only record (pre-round-5 journals)
-                    _, r, name, donor = rec
-                    m.sync_laggard(r, name, donor=donor)
-            elif op == OP_REG:
+            if op == OP_REG:
                 pending_reg = (rec[1], rec[2])
-            elif op == OP_TICK:
+            elif op != OP_TICK:
+                _replay_admin_op(m, rec)
+            else:
                 _, tick_num, placed, alive_b = rec[:4]
                 bulk_rec = rec[4] if len(rec) > 4 else None
                 if pending_reg is not None:
@@ -987,27 +1143,10 @@ def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
                 bulk_placed = None
                 if bulk_rec is not None and bulk_replay is not None:
                     bulk_placed = bulk_replay(m, bufs, bulk_rec)
-                m._placed = []
-                for row, entries in placed:
-                    take = []
-                    placed_rids = set()
-                    for rid, entry, p, payload, stop in entries:
-                        m._next_rid = max(m._next_rid, rid + 1)
-                        placed_rids.add(rid)
-                        if rid not in m.outstanding:
-                            m.outstanding[rid] = make_record(
-                                m, rid, row, payload, stop, entry
-                            )
-                        place(bufs, entry, p, row, rid, stop)
-                        take.append((rid, entry, p))
-                    m._placed.append((row, take))
-                    # a snapshot may hold queue copies of requests whose
-                    # placement is journaled after it; drop them or they
-                    # would be proposed (and committed) a second time
-                    if row in m._queues and placed_rids:
-                        m._queues[row] = collections.deque(
-                            r for r in m._queues[row] if r not in placed_rids
-                        )
+                _stage_placed(
+                    m, placed, make_record,
+                    on_place=lambda e, p, row, rid, stop: place(
+                        bufs, e, p, row, rid, stop))
                 alive = np.frombuffer(alive_b, dtype=bool)
                 m.state, out = tick_fn(m.state, build_inbox(bufs, alive))
                 proc = getattr(m, "_replay_process", None)
@@ -1031,8 +1170,429 @@ def replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
         m._repaired_last.clear()
 
 
+#: scatter budget floor for the batched replay arm: replay outboxes must
+#: hold a whole tick's executions, and journaled intake can burst past the
+#: live exec budget, so the floor keeps overflow fallbacks rare
+_REPLAY_SCAT_MIN = int(os.environ.get("GPTPU_REPLAY_SCAT_BUDGET", "4096"))
+
+#: dense-vs-sparse crossover: a window goes sparse when its padded active
+#: row count times this factor still fits under the full plane width
+_SPARSE_FACTOR = 4
+
+
+def _sparse_rows(acts: np.ndarray, width: int) -> np.ndarray:
+    """The gathered row list for one plane: the window's active rows
+    (sorted — the compact exec stream's rank order over the narrow plane
+    must match the dense arm's global row order) padded to a power of two
+    with idle rows (one compiled scan per width class).  Idle pads are
+    provably no-ops under the tick fold, but they MUST be duplicate-free
+    against the active set: a row gathered twice would scatter back in
+    unspecified order.  A plane too small to be worth slicing is taken
+    whole."""
+    A = len(acts)
+    Ap = 8
+    while Ap < A:
+        Ap *= 2
+    if Ap >= width:
+        return np.arange(width, dtype=np.int64)
+    pads = np.setdiff1d(
+        np.arange(min(width, Ap + A), dtype=np.int64), acts)[:Ap - A]
+    return np.concatenate([acts, pads])
+
+
+class _SparsePlan:
+    """One window's sparse-replay geometry: the gathered global row lists
+    per plane, the composite-local row map for the COO columns and for
+    mapping the compact outbox's exec/lag rows back to global."""
+
+    def __init__(self, m, rows_l, rows_r, g_log: int):
+        from ..ops.tick import CompactLayout
+
+        self.rows_l = rows_l
+        self.rows_r = rows_r
+        self.wl = len(rows_l)
+        self.wr = len(rows_r) if rows_r is not None else 0
+        # combined[i] is the GLOBAL composite row at sparse-local index i
+        # (register rows ride at g_log + row, mirroring the dense layout)
+        self.combined = (rows_l if rows_r is None else
+                         np.concatenate([rows_l, g_log + rows_r]))
+        self.width = self.wl + self.wr
+        inv = np.full(m.G_total + 1, self.width, np.int32)
+        inv[self.combined] = np.arange(self.width, dtype=np.int32)
+        self.inv = inv
+        self.layout_l = CompactLayout(m.R, self.wl, max(
+            m._exec_budget, _REPLAY_SCAT_MIN), m._lag_budget)
+
+
+class _BatchedReplay:
+    """Window dispatcher for the columnar replay arm.
+
+    Buffers decoded OP_TICK records and, K at a time, flattens them into a
+    :class:`~gigapaxos_tpu.wal.columnar.TickSlab`, ships the window as
+    padded COO columns through one ``replay_scan_ticks*`` program, then
+    runs the host fold strictly in tick order over the per-tick compact
+    rows.  The host ordering is the invariant that buys bit-identity with
+    the reference arm: the device work for all K ticks is journal-
+    determined (inboxes come from the log, not from host state), but
+    staging (outstanding creation, queue dedup) and `_process_compact`
+    (requeues, app execution, watermark folds) for tick k must complete
+    before tick k+1's staging — so the dispatcher stages/processes
+    per tick AFTER the one batched dispatch.
+
+    Overflow safety: the compact header carries the TRUE pre-drop n_exec,
+    and the scan programs do not donate their inputs, so a tick whose
+    executions exceed the scatter budget discards the window's outputs
+    and re-runs it through the exact record-at-a-time body."""
+
+    def __init__(self, m, make_record, new_buffers, place, build_inbox,
+                 tick_fn, bulk_replay, batch_ticks: int):
+        from ..ops.tick import CompactLayout
+
+        self.m = m
+        self.make_record = make_record
+        self.new_buffers = new_buffers
+        self.place = place
+        self.build_inbox = build_inbox
+        self.tick_fn = tick_fn
+        self.bulk_replay = bulk_replay
+        self.K = max(2, int(batch_ticks))
+        self.mixed = m.rstate is not None
+        self.lease = m._lease is not None
+        # state must evolve EXACTLY as the live run's did (same budget
+        # semantics as the reference arm's tick closure)
+        self.exec_budget = m._exec_budget if m._use_compact else 0
+        self.scat = max(m._exec_budget, _REPLAY_SCAT_MIN)
+        self.lagb = m._lag_budget
+        self.g_log = m.G
+        self.g_reg = m.G_reg if self.mixed else 0
+        self.layout_l = CompactLayout(m.R, m.G, self.scat, self.lagb)
+        # sparse window replay: sound only when idle rows are exact
+        # no-ops under the tick fold — the lease countdown and the health
+        # heat decay advance every row every tick, so those planes stay
+        # on the dense scan
+        self.health = getattr(m, "_health", None) is not None
+        self.pending: list = []
+        self.windows = 0
+        self.sparse_windows = 0
+        self.overflows = 0
+
+    def add(self, rec) -> None:
+        self.pending.append(rec)
+        if len(self.pending) >= self.K:
+            chunk = self.pending[:self.K]
+            del self.pending[:self.K]
+            self._run_window(chunk)
+
+    def flush(self) -> None:
+        """Drain buffered ticks: full windows through the scan program,
+        the <K tail through the record-at-a-time body (one compiled scan
+        shape per recovery, no tail-sized recompiles)."""
+        while len(self.pending) >= self.K:
+            chunk = self.pending[:self.K]
+            del self.pending[:self.K]
+            self._run_window(chunk)
+        if self.pending:
+            from .columnar import build_tick_slab
+
+            slab = build_tick_slab(self.pending, self.m.R, resolve=False)
+            self.pending = []
+            for t in range(len(slab)):
+                self._reference_tick(slab, t)
+
+    # ------------------------------------------------------------ internals
+
+    def _run_window(self, chunk) -> None:
+        from .columnar import build_tick_slab, coo_window
+        from ..ops.tick import (LP_HOLDER, replay_scan_ticks,
+                                replay_scan_ticks_lease,
+                                replay_scan_ticks_mixed,
+                                replay_scan_ticks_mixed_lease)
+
+        m = self.m
+        K = len(chunk)
+        slab = build_tick_slab(chunk, m.R, resolve=False)
+        M = 8  # pow2 pad width: one compiled program per (K, M) class
+        while M < slab.max_entries():
+            M *= 2
+        e, p, g, rid, stop, alive = coo_window(slab, 0, K, m.G_total, M)
+        xs = {"e": e, "p": p, "g": g, "rid": rid, "stop": stop,
+              "alive": alive}
+        self.windows += 1
+        sp = self._sparse_plan(g)
+        if sp is not None:
+            if self._run_window_sparse(sp, xs, slab, K):
+                return
+            # a tick overflowed the scatter budget: pre-window state is
+            # intact (gather copies, scatter never ran), so the whole
+            # window re-runs through the exact unbudgeted body
+            self.overflows += 1
+            for t in range(K):
+                self._reference_tick(slab, t)
+            return
+        rst = ls = rls = lp_last = waits = None
+        if self.mixed and self.lease:
+            (st, rst, ls, rls, packs, lp_last,
+             waits) = replay_scan_ticks_mixed_lease(
+                m.state, m.rstate, m._lease, m._rlease, xs, m.P,
+                self.exec_budget, self.scat, self.lagb, m._lease_horizon)
+        elif self.lease:
+            st, ls, packs, lp_last, waits = replay_scan_ticks_lease(
+                m.state, m._lease, xs, m.P, self.exec_budget, self.scat,
+                self.lagb, m._lease_horizon)
+        elif self.mixed:
+            st, rst, packs = replay_scan_ticks_mixed(
+                m.state, m.rstate, xs, m.P, self.exec_budget, self.scat,
+                self.lagb)
+        else:
+            st, packs = replay_scan_ticks(
+                m.state, xs, m.P, self.exec_budget, self.scat, self.lagb)
+        packs = np.asarray(packs)
+        over = packs[:, 0] > self.scat
+        if self.mixed:
+            over = over | (packs[:, self.layout_l.total_plain] > self.scat)
+        if over.any():
+            # inputs were not donated: pre-window state is intact, so the
+            # whole window re-runs through the exact unbudgeted body
+            self.overflows += 1
+            for t in range(K):
+                self._reference_tick(slab, t)
+            return
+        m.state = st
+        if rst is not None:
+            m.rstate = rst
+        if ls is not None:
+            m._lease = ls
+            if rls is not None:
+                m._rlease = rls
+            # the host mirror only ever holds the latest pack, so adopt
+            # the FINAL tick's; the clock advances K in lockstep with the
+            # device fold, and waits accumulate per tick (scan summed them)
+            if isinstance(lp_last, tuple):
+                lp = np.concatenate([np.asarray(lp_last[0]),
+                                     np.asarray(lp_last[1])], axis=1)
+            else:
+                lp = np.asarray(lp_last)
+            m._lease_np = lp.copy()
+            m._lease_clock += K
+            m._lease_gauge.set(int((lp[LP_HOLDER] >= 0).sum()))
+            w = int(np.asarray(waits).sum())
+            if w:
+                m._lease_waits_c.inc(w)
+        for k in range(K):
+            self._host_tick(slab, k, packs[k])
+
+    def _sparse_plan(self, g: np.ndarray):
+        """Decide whether this window replays sparse, and build the plan.
+
+        The window's active rows are exactly the COO row column's
+        non-padding values (placed ∪ bulk — ``coo_window`` already folded
+        both in).  Sparse wins when the padded active set is a small
+        fraction of the plane; ``GPTPU_REPLAY_SPARSE`` forces it on
+        (tests) or off (A/B)."""
+        mode = os.environ.get("GPTPU_REPLAY_SPARSE", "auto")
+        if mode in ("0", "off") or self.lease or self.health:
+            return None
+        m = self.m
+        acts = np.unique(g[g < m.G_total]).astype(np.int64)
+        if self.mixed:
+            split = int(np.searchsorted(acts, self.g_log))
+            rows_l = _sparse_rows(acts[:split], self.g_log)
+            rows_r = _sparse_rows(acts[split:] - self.g_log, self.g_reg)
+        else:
+            rows_l = _sparse_rows(acts, self.g_log)
+            rows_r = None
+        sp = _SparsePlan(m, rows_l, rows_r, self.g_log)
+        if mode not in ("1", "force") and (
+                sp.width * _SPARSE_FACTOR >= m.G_total):
+            return None
+        return sp
+
+    def _run_window_sparse(self, sp, xs, slab, K: int) -> bool:
+        """Gather → scan at width A → scatter back.  Returns False on a
+        scatter-budget overflow WITHOUT touching manager state (the
+        caller re-runs the window record-at-a-time)."""
+        import jax.numpy as jnp
+
+        from ..ops.tick import (replay_gather_rows, replay_scan_ticks,
+                                replay_scan_ticks_mixed,
+                                replay_scatter_rows)
+
+        m = self.m
+        xs = dict(xs, g=sp.inv[xs["g"]])
+        rows_l = jnp.asarray(sp.rows_l, jnp.int32)
+        cst = replay_gather_rows(m.state, rows_l)
+        if self.mixed:
+            rows_r = jnp.asarray(sp.rows_r, jnp.int32)
+            crst = replay_gather_rows(m.rstate, rows_r)
+            st, rst, packs = replay_scan_ticks_mixed(
+                cst, crst, xs, m.P, self.exec_budget, self.scat,
+                self.lagb)
+        else:
+            st, packs = replay_scan_ticks(
+                cst, xs, m.P, self.exec_budget, self.scat, self.lagb)
+        packs = np.asarray(packs)
+        over = packs[:, 0] > self.scat
+        if self.mixed:
+            over = over | (packs[:, sp.layout_l.total_plain] > self.scat)
+        if over.any():
+            return False
+        self.sparse_windows += 1
+        m.state = replay_scatter_rows(m.state, st, rows_l)
+        if self.mixed:
+            m.rstate = replay_scatter_rows(m.rstate, rst, rows_r)
+        for k in range(K):
+            self._host_tick(slab, k, packs[k], sp)
+        return True
+
+    def _host_tick(self, slab, k: int, row, sp=None) -> None:
+        """Tick k's host half, strictly in order: bulk admit, staging,
+        compact fold, tick counter — the same sequence (and the same
+        code) the reference arm runs around its per-tick dispatch."""
+        from .columnar import resolved_placed
+
+        m = self.m
+        bulk_placed = None
+        if slab.bulk[k] is not None and self.bulk_replay is not None:
+            bulk_placed = self.bulk_replay(m, None, slab.bulk[k])
+        _stage_placed(m, resolved_placed(slab, k), self.make_record)
+        m._process_compact(self._unpack(row, sp), m._placed, bulk_placed)
+        m.tick_num = int(slab.tick_nums[k]) + 1
+
+    def _unpack(self, row, sp=None):
+        from ..ops.tick import merge_compact_outbox, unpack_compact
+
+        m = self.m
+        if sp is None:
+            if not self.mixed:
+                return unpack_compact(row, m.R, self.g_log, self.scat,
+                                      self.lagb)
+            tl = self.layout_l.total_plain
+            co_l = unpack_compact(row[:tl], m.R, self.g_log, self.scat,
+                                  self.lagb)
+            co_r = unpack_compact(row[tl:], m.R, self.g_reg, self.scat,
+                                  self.lagb)
+            return merge_compact_outbox(co_l, co_r, self.g_log)
+        # sparse window: unpack at the narrow widths, then map the exec
+        # and lag streams' rows back to global composite space and expand
+        # the intake bits into the full plane (idle rows never take)
+        if not self.mixed:
+            co = unpack_compact(row, m.R, sp.wl, self.scat, self.lagb)
+        else:
+            tl = sp.layout_l.total_plain
+            co_l = unpack_compact(row[:tl], m.R, sp.wl, self.scat,
+                                  self.lagb)
+            co_r = unpack_compact(row[tl:], m.R, sp.wr, self.scat,
+                                  self.lagb)
+            co = merge_compact_outbox(co_l, co_r, sp.wl)
+        taken = np.zeros((m.R, m.G_total), np.int32)
+        taken[:, sp.combined] = co.taken_bits
+        return co._replace(
+            taken_bits=taken,
+            e_row=sp.combined[np.asarray(co.e_row, np.int64)],
+            l_row=sp.combined[np.asarray(co.l_row, np.int64)])
+
+    def _reference_tick(self, slab, t: int) -> None:
+        """Exact record-at-a-time tick body (tails + overflow fallback),
+        reconstructed from the slab's columns."""
+        from .columnar import resolved_placed
+
+        m = self.m
+        bufs = self.new_buffers(m)
+        bulk_placed = None
+        if slab.bulk[t] is not None and self.bulk_replay is not None:
+            bulk_placed = self.bulk_replay(m, bufs, slab.bulk[t])
+        _stage_placed(
+            m, resolved_placed(slab, t), self.make_record,
+            on_place=lambda e, p, row, rid, stop: self.place(
+                bufs, e, p, row, rid, stop))
+        m.state, out = self.tick_fn(
+            m.state, self.build_inbox(bufs, slab.alive[t]))
+        if bulk_placed is not None:
+            m._process_outbox(out, None, bulk_placed)
+        else:
+            m._process_outbox(out)
+        m.tick_num = int(slab.tick_nums[t]) + 1
+
+
+def replay_journals_batched(m, log_dir, start_seq, make_record, new_buffers,
+                            place, build_inbox, tick_fn, bulk_replay=None,
+                            progress=None, batch_ticks=None):
+    """Columnar fast arm of journal replay (ISSUE 19).
+
+    Identical decode, payref resolution, staging and host fold as
+    :func:`replay_journals`, but OP_TICK records are buffered and shipped
+    to the device K at a time through the ``replay_scan_ticks*`` programs
+    — one dispatch and one ``[K, total]`` compact pull per window instead
+    of one round trip per tick.  Admin ops are batch barriers: they
+    mutate rows/state outside the tick body, so buffered ticks flush
+    before one applies.  Bit-identity with the reference arm (state,
+    apps, re-logged journal bytes) is asserted by
+    tests/test_replay_batched.py.  Returns the dispatcher (window /
+    overflow counters) for observability."""
+    if batch_ticks is None:
+        batch_ticks = int(os.environ.get("GPTPU_REPLAY_BATCH", "8"))
+    disp = _BatchedReplay(m, make_record, new_buffers, place, build_inbox,
+                          tick_fn, bulk_replay, batch_ticks)
+    pay_tab: dict = {}
+    pending_reg = None
+    paths = sorted(glob.glob(os.path.join(log_dir, "journal.*.log")))
+    if progress is not None:
+        progress.begin([p for p in paths
+                        if int(os.path.basename(p).split(".")[1])
+                        >= start_seq])
+    for path in paths:
+        seq = int(os.path.basename(path).split(".")[1])
+        if seq < start_seq:
+            continue
+        newest = path == paths[-1]
+        scan = _scan_for_replay(path, newest, meta_only=True)
+        if progress is not None:
+            progress.file_scanned(path, scan)
+        for idx, raw in enumerate(iter_scan_records(path, scan)):
+            if progress is not None:
+                progress.advance()
+            try:
+                rec = _load_op(raw, OP_SCHEMA)
+                if rec[0] == OP_TICK:
+                    rec = _resolve_tick_payrefs(rec, pay_tab)
+                elif rec[0] == OP_REG:
+                    rec = (OP_REG, rec[1],
+                           _resolve_placed(rec[2], pay_tab))
+            except (ValueError, IndexError) as e:
+                if _tolerate_or_raise(path, idx, scan, newest, e):
+                    # everything before the bad record still replays
+                    disp.flush()
+                    break
+            op = rec[0]
+            if op == OP_REG:
+                pending_reg = (rec[1], rec[2])
+            elif op == OP_TICK:
+                tick_num, placed = rec[1], rec[2]
+                if pending_reg is not None:
+                    # fold the stashed register-plane placements into this
+                    # tick's inbox (writer guarantees matching tick_num)
+                    if pending_reg[0] == tick_num:
+                        placed = list(placed) + pending_reg[1]
+                        rec = rec[:2] + (placed,) + rec[3:]
+                    pending_reg = None
+                if tick_num < m.tick_num:
+                    continue  # already inside the snapshot
+                disp.add(rec)
+            else:
+                disp.flush()  # admin ops mutate outside the tick body
+                _replay_admin_op(m, rec)
+    disp.flush()
+    # same post-replay hygiene as the reference arm (see its comments)
+    if hasattr(m, "_lag_sync_due"):
+        m._lag_sync_due.clear()
+    if hasattr(m, "_repaired_last"):
+        m._repaired_last.clear()
+    return disp
+
+
 def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
-            spill_ns: str = "default"):
+            spill_ns: str = "default", replay_mode: Optional[str] = None,
+            progress: Optional[ReplayProgress] = None):
     """Rebuild a PaxosManager from disk: snapshot + deterministic tick replay
     (the analog of the reference's 3-pass recovery,
     PaxosManager.java:1852-2055, where pass 2 re-drives logged messages
@@ -1266,12 +1826,38 @@ def recover(cfg, n_replicas: int, apps, log_dir: str, native: bool = True,
             m._bulk_leftover = m._bulk_leftover[
                 ~np.isin(m._bulk_leftover, rids)
             ]
-        bufs[0][be, bp, br] = rids.astype(np.int32)
-        bufs[1][be, bp, br] = stops
+        if bufs is not None:  # batched arm ships COO, not dense buffers
+            bufs[0][be, bp, br] = rids.astype(np.int32)
+            bufs[1][be, bp, br] = stops
         return (rids, be, bp, br)
 
-    replay_journals(m, log_dir, start_seq, make_record, new_buffers, place,
-                    build_inbox, tick_host, bulk_replay=bulk_replay)
+    mode = replay_mode or os.environ.get("GPTPU_REPLAY_MODE", "batched")
+    if getattr(m, "_device_app", False) or getattr(m, "mesh", None) is not None:
+        # the fused device-KV replay threads per-tick descriptor uploads
+        # through its tick closure, and mesh runs replay through sharded
+        # programs — both keep the record-at-a-time path
+        mode = "reference"
+    if progress is None:
+        progress = ReplayProgress(log_dir)
+    try:
+        if mode == "batched":
+            disp = replay_journals_batched(
+                m, log_dir, start_seq, make_record, new_buffers, place,
+                build_inbox, tick_host, bulk_replay=bulk_replay,
+                progress=progress)
+            # dispatcher counters survive for observability/tests: how
+            # many windows ran, how many took the sparse gather path,
+            # how many overflowed back to the reference body
+            m._replay_windows = disp.windows
+            m._replay_sparse_windows = disp.sparse_windows
+            m._replay_overflows = disp.overflows
+        else:
+            replay_journals(
+                m, log_dir, start_seq, make_record, new_buffers, place,
+                build_inbox, tick_host, bulk_replay=bulk_replay,
+                progress=progress)
+    finally:
+        progress.finish()
     if hasattr(m, "_replay_process"):
         del m._replay_process
     # reattach logging

@@ -1,0 +1,99 @@
+"""6-seed SIGKILL crash/recover soak (ISSUE 19 satellite): a randomized
+workload, a process-death kill of a random node, majority-only traffic
+while it is down, then recovery with parallel peer snapshot streaming
+active — asserting the per-slot S1 ledger cluster-wide (no (group, slot)
+ever executes two rids, across the crash) and zero lost acked decisions
+(every write acked before or during the outage is present on every node,
+including the restarted one, after catch-up)."""
+
+import numpy as np
+import pytest
+
+from gigapaxos_tpu.models.replicable import KVApp
+from gigapaxos_tpu.modeb import PeerCheckpointStreamer, recover_modeb
+from gigapaxos_tpu.net.messenger import Messenger
+from gigapaxos_tpu.testing.chaos import SafetyLedger
+from test_modeb import IDS, Cluster, make_cfg
+
+SERVICES = ["svcA", "svcB", "svcC"]
+
+
+@pytest.mark.parametrize("seed", [
+    pytest.param(s, marks=pytest.mark.slow) if s >= 3 else s
+    for s in range(6)
+])
+def test_sigkill_crash_recover_soak(tmp_path, seed):
+    rng = np.random.default_rng(seed)
+    cfg = make_cfg()
+    cl = Cluster(cfg, wal_root=tmp_path)
+    led = SafetyLedger()
+    for nid in IDS:
+        led.attach(nid, cl.nodes[nid])
+    acked = {s: {} for s in SERVICES}  # service -> key -> value (acked only)
+    try:
+        for s in SERVICES:
+            cl.create(s)
+
+        def put(i, only=None):
+            at = rng.choice(sorted(only) if only else IDS)
+            s = SERVICES[int(rng.integers(len(SERVICES)))]
+            k, v = f"k{i}", f"v{seed}.{i}"
+            assert cl.commit(str(at), s, f"PUT {k} {v}".encode(),
+                             only=only) == b"OK"
+            acked[s][k] = v
+
+        for i in range(int(rng.integers(6, 10))):
+            put(i)
+        cl.ticks(int(rng.integers(2, 6)))
+
+        victim = IDS[int(rng.integers(len(IDS)))]
+        survivors = {n for n in IDS if n != victim}
+        cl.kill(victim)
+        cl.drop_backlog(victim)
+        for i in range(int(rng.integers(3, 6))):
+            put(100 + i, only=survivors)
+
+        # recover with parallel peer snapshot streaming from both survivors
+        donors = sorted(survivors)
+        rng.shuffle(donors)
+        ps = PeerCheckpointStreamer(
+            {nid: cl.nodes[nid].donate_ckpt for nid in donors}, window=2)
+        cl.apps[victim] = KVApp()
+        node = recover_modeb(cfg, IDS, victim, cl.apps[victim],
+                             str(tmp_path / victim), native=False,
+                             peer_stream=ps)
+        # rows that missed writes during the outage were streamed (the
+        # quiesced-watermark case legitimately yields only stale blobs)
+        assert ps.stats["fetched"] >= len(SERVICES)
+        assert ps.stats["failed"] == 0
+        led.attach(victim, node)
+        m = Messenger(victim, ("127.0.0.1", 0), cl.nodemap)
+        cl.nodemap.add(victim, "127.0.0.1", m.port)
+        cl.msgs[victim] = m
+        node.attach_messenger(m)
+        node.request_sync()
+        cl.nodes[victim] = node
+        back_r = IDS.index(victim)
+        for n in cl.nodes.values():
+            n.set_alive(back_r, True)
+        # zero lost acked decisions: every acked write on every node.  A
+        # donor that acked a write it had not yet executed streams a blob
+        # one slot short — anti-entropy owes the tail, so catch-up is
+        # bounded-eventual, not instant
+        def missing():
+            return [(nid, s, k)
+                    for nid in IDS
+                    for s in SERVICES
+                    for k, v in acked[s].items()
+                    if cl.apps[nid].db.get(s, {}).get(k) != v]
+
+        for _ in range(10):
+            cl.ticks(20)
+            if not missing():
+                break
+        assert not missing(), f"seed {seed}: lost acked writes {missing()}"
+        # S1 across the crash: replayed + streamed + live executions agree
+        assert led.observations > 0
+        led.assert_safe()
+    finally:
+        cl.close()
